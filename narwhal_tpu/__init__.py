@@ -1,0 +1,10 @@
+"""narwhal_tpu — a TPU-native DAG mempool + BFT consensus framework.
+
+A from-scratch re-design of Narwhal & Tusk (reference: erwanor/narwhal at
+/root/reference, Rust) for TPU hardware: asyncio actor runtime, canonical
+binary codec, ed25519 multi-signature certificates whose verification batches
+onto a JAX/Pallas verifier, and consensus ordering expressed as vectorized
+adjacency-tensor walks over a dense [rounds x authorities] DAG window.
+"""
+
+__version__ = "0.1.0"

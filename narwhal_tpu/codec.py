@@ -1,0 +1,143 @@
+"""Canonical deterministic binary serialization.
+
+The reference serializes all wire types with bincode (little-endian, length
+prefixes; see /root/reference/types/build.rs:42-121 where anemo services use a
+bincode codec, and /root/reference/node/src/generate_format.rs which snapshots
+the serde formats for stability). We define our own equally-simple canonical
+encoding rather than chasing bincode compatibility: little-endian fixed-width
+integers, u32 length prefixes for byte strings and sequences, and maps encoded
+as key-sorted sequences so that encoding is a pure function of the value.
+
+A format-snapshot test (tests/test_formats.py, mirroring
+/root/reference/node/tests/formats.rs:5) guards accidental format drift.
+"""
+
+from __future__ import annotations
+
+import struct
+
+_U8 = struct.Struct("<B")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class Writer:
+    """Append-only canonical encoder."""
+
+    __slots__ = ("_parts",)
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def u8(self, v: int) -> "Writer":
+        self._parts.append(_U8.pack(v))
+        return self
+
+    def u16(self, v: int) -> "Writer":
+        self._parts.append(_U16.pack(v))
+        return self
+
+    def u32(self, v: int) -> "Writer":
+        self._parts.append(_U32.pack(v))
+        return self
+
+    def u64(self, v: int) -> "Writer":
+        self._parts.append(_U64.pack(v))
+        return self
+
+    def raw(self, b: bytes) -> "Writer":
+        """Fixed-size field: no length prefix (caller knows the size)."""
+        self._parts.append(b)
+        return self
+
+    def bytes(self, b: bytes) -> "Writer":
+        self._parts.append(_U32.pack(len(b)))
+        self._parts.append(b)
+        return self
+
+    def seq(self, items, enc) -> "Writer":
+        items = list(items)
+        self._parts.append(_U32.pack(len(items)))
+        for it in items:
+            enc(self, it)
+        return self
+
+    def sorted_map(self, mapping, enc_key, enc_val) -> "Writer":
+        """Maps are encoded sorted by raw key so encoding is canonical."""
+        items = sorted(mapping.items())
+        self._parts.append(_U32.pack(len(items)))
+        for k, v in items:
+            enc_key(self, k)
+            enc_val(self, v)
+        return self
+
+    def finish(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Reader:
+    """Matching decoder. Raises CodecError on truncation."""
+
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self._buf = buf
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        end = self._pos + n
+        if end > len(self._buf):
+            raise CodecError(
+                f"truncated input: need {n} bytes at offset {self._pos}, "
+                f"have {len(self._buf) - self._pos}"
+            )
+        out = self._buf[self._pos : end]
+        self._pos = end
+        return out
+
+    def u8(self) -> int:
+        return _U8.unpack(self._take(1))[0]
+
+    def u16(self) -> int:
+        return _U16.unpack(self._take(2))[0]
+
+    def u32(self) -> int:
+        return _U32.unpack(self._take(4))[0]
+
+    def u64(self) -> int:
+        return _U64.unpack(self._take(8))[0]
+
+    def raw(self, n: int) -> bytes:
+        return self._take(n)
+
+    def bytes(self) -> bytes:
+        return self._take(self.u32())
+
+    def seq(self, dec) -> list:
+        n = self.u32()
+        if n > len(self._buf) - self._pos:
+            # Every element costs >=1 byte; cheap sanity bound against
+            # maliciously huge length prefixes.
+            raise CodecError(f"sequence length {n} exceeds remaining input")
+        return [dec(self) for _ in range(n)]
+
+    def map(self, dec_key, dec_val) -> dict:
+        n = self.u32()
+        if n > len(self._buf) - self._pos:
+            raise CodecError(f"map length {n} exceeds remaining input")
+        out = {}
+        for _ in range(n):
+            k = dec_key(self)
+            out[k] = dec_val(self)
+        return out
+
+    def done(self) -> None:
+        if self._pos != len(self._buf):
+            raise CodecError(
+                f"{len(self._buf) - self._pos} trailing bytes after decode"
+            )
+
+
+class CodecError(ValueError):
+    pass

@@ -1,0 +1,336 @@
+"""Parameters, Committee and WorkerCache.
+
+Reference: /root/reference/config/src/lib.rs — Parameters :107-138 (defaults
+:259-275), Committee + stake math :488-685, WorkerCache :360-473, JSON
+Import/Export traits :65-97, SharedCommittee/SharedWorkerCache hot-swap :358,485.
+
+Addresses here are plain "host:port" strings (the reference uses multiaddrs
+over QUIC; our transport is an asyncio TCP mesh, see network/). Durations are
+float seconds in memory, serialized as milliseconds in JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from dataclasses import asdict, dataclass, field, replace
+from typing import Mapping
+
+from .crypto import blake2b_256
+from .types import Epoch, PublicKey, Round, WorkerId
+
+Stake = int
+
+
+@dataclass
+class Parameters:
+    """Tuning knobs (/root/reference/config/src/lib.rs:107-275 defaults)."""
+
+    header_size: int = 1_000  # bytes of payload digests before sealing a header
+    max_header_delay: float = 0.1  # s; reference default 100ms
+    gc_depth: int = 50  # rounds
+    sync_retry_delay: float = 5.0  # s
+    sync_retry_nodes: int = 3  # lucky-broadcast fan-out
+    batch_size: int = 500_000  # bytes
+    max_batch_delay: float = 0.1  # s
+    max_concurrent_requests: int = 500_000
+    block_synchronizer_range_timeout: float = 30.0
+    block_synchronizer_certs_timeout: float = 2.0
+    block_synchronizer_payload_timeout: float = 2.0
+    block_synchronizer_payload_retries: int = 5
+    consensus_api_grpc_address: str = "127.0.0.1:0"
+    prometheus_address: str = "127.0.0.1:0"
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "Parameters":
+        data = json.loads(text)
+        known = {f for f in Parameters.__dataclass_fields__}
+        return Parameters(**{k: v for k, v in data.items() if k in known})
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @staticmethod
+    def import_(path: str) -> "Parameters":
+        with open(path) as f:
+            return Parameters.from_json(f.read())
+
+
+@dataclass(frozen=True)
+class Authority:
+    """Stake + addresses of one validator
+    (/root/reference/config/src/lib.rs:475-486)."""
+
+    stake: Stake
+    primary_address: str
+    network_key: PublicKey
+
+
+class Committee:
+    """The validator set with stake math
+    (/root/reference/config/src/lib.rs:488-685)."""
+
+    def __init__(self, authorities: Mapping[PublicKey, Authority], epoch: Epoch = 0):
+        # Canonical order: sorted by public key. Index in this order is the
+        # authority's dense id used by certificates' signer lists and by every
+        # TPU DAG tensor ([rounds x authorities] layout).
+        self.authorities: dict[PublicKey, Authority] = dict(
+            sorted(authorities.items())
+        )
+        self.epoch = epoch
+        self._keys: list[PublicKey] = list(self.authorities)
+        self._index: dict[PublicKey, int] = {pk: i for i, pk in enumerate(self._keys)}
+        self._total_stake: Stake = sum(a.stake for a in self.authorities.values())
+
+    # -- size / stake -----------------------------------------------------
+    def size(self) -> int:
+        return len(self.authorities)
+
+    def stake(self, name: PublicKey) -> Stake:
+        a = self.authorities.get(name)
+        return a.stake if a else 0
+
+    def total_stake(self) -> Stake:
+        return self._total_stake
+
+    def quorum_threshold(self) -> Stake:
+        """2f+1 equivalent: ceil((2N+1)/3) of total stake
+        (/root/reference/config/src/lib.rs:537-544)."""
+        return (2 * self._total_stake) // 3 + 1
+
+    def validity_threshold(self) -> Stake:
+        """f+1 equivalent (/root/reference/config/src/lib.rs:546-550)."""
+        return (self._total_stake + 2) // 3
+
+    # -- identity ---------------------------------------------------------
+    def authority_keys(self) -> list[PublicKey]:
+        return self._keys
+
+    def index_of(self, name: PublicKey) -> int:
+        return self._index[name]
+
+    def key_of(self, index: int) -> PublicKey:
+        return self._keys[index]
+
+    def stakes_array(self) -> list[Stake]:
+        return [self.authorities[pk].stake for pk in self._keys]
+
+    # -- leader election --------------------------------------------------
+    def leader(self, seed: int) -> PublicKey:
+        """Stake-weighted deterministic leader
+        (/root/reference/config/src/lib.rs:553-567): a seeded PRNG pick
+        weighted by stake. We derive the pick from blake2b(seed) so every
+        implementation (host Python, JAX kernel) agrees bit-for-bit."""
+        h = blake2b_256(seed.to_bytes(8, "little") + self.epoch.to_bytes(8, "little"))
+        ticket = int.from_bytes(h[:8], "little") % self._total_stake
+        acc = 0
+        for pk in self._keys:
+            acc += self.authorities[pk].stake
+            if ticket < acc:
+                return pk
+        return self._keys[-1]
+
+    def leader_index(self, seed: int) -> int:
+        return self._index[self.leader(seed)]
+
+    # -- addressing -------------------------------------------------------
+    def primary_address(self, name: PublicKey) -> str:
+        return self.authorities[name].primary_address
+
+    def network_key(self, name: PublicKey) -> PublicKey:
+        return self.authorities[name].network_key
+
+    def others_primaries(self, me: PublicKey) -> list[tuple[PublicKey, str, PublicKey]]:
+        """(name, address, network_key) of every other primary
+        (/root/reference/config/src/lib.rs:585-600)."""
+        return [
+            (pk, a.primary_address, a.network_key)
+            for pk, a in self.authorities.items()
+            if pk != me
+        ]
+
+    def update_primary_network_info(
+        self, updates: Mapping[PublicKey, tuple[Stake, str]]
+    ) -> None:
+        """Mid-epoch address updates
+        (/root/reference/config/src/lib.rs:621-685): every authority must be
+        covered and stakes must match."""
+        if set(updates) != set(self.authorities):
+            raise ValueError("updates must cover exactly the current committee")
+        for pk, (stake, addr) in updates.items():
+            if self.authorities[pk].stake != stake:
+                raise ValueError(f"stake mismatch for {pk.hex()[:16]}")
+        for pk, (stake, addr) in updates.items():
+            self.authorities[pk] = replace(self.authorities[pk], primary_address=addr)
+
+    # -- serialization ----------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "epoch": self.epoch,
+                "authorities": {
+                    pk.hex(): {
+                        "stake": a.stake,
+                        "primary_address": a.primary_address,
+                        "network_key": a.network_key.hex(),
+                    }
+                    for pk, a in self.authorities.items()
+                },
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "Committee":
+        data = json.loads(text)
+        return Committee(
+            {
+                bytes.fromhex(pk): Authority(
+                    stake=a["stake"],
+                    primary_address=a["primary_address"],
+                    network_key=bytes.fromhex(a["network_key"]),
+                )
+                for pk, a in data["authorities"].items()
+            },
+            epoch=data["epoch"],
+        )
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @staticmethod
+    def import_(path: str) -> "Committee":
+        with open(path) as f:
+            return Committee.from_json(f.read())
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Committee)
+            and self.epoch == other.epoch
+            and self.authorities == other.authorities
+        )
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    """(/root/reference/config/src/lib.rs:348-358): name = worker network key,
+    transactions = client-facing tx ingest address, worker_address = the
+    worker<->worker mesh address."""
+
+    name: PublicKey
+    transactions: str
+    worker_address: str
+
+
+class WorkerCache:
+    """Worker topology of the whole committee
+    (/root/reference/config/src/lib.rs:360-473)."""
+
+    def __init__(
+        self, workers: Mapping[PublicKey, Mapping[WorkerId, WorkerInfo]], epoch: Epoch = 0
+    ):
+        self.workers: dict[PublicKey, dict[WorkerId, WorkerInfo]] = {
+            pk: dict(ws) for pk, ws in workers.items()
+        }
+        self.epoch = epoch
+
+    def worker(self, authority: PublicKey, worker_id: WorkerId) -> WorkerInfo:
+        return self.workers[authority][worker_id]
+
+    def has_worker(self, authority: PublicKey, worker_id: WorkerId) -> bool:
+        return worker_id in self.workers.get(authority, {})
+
+    def our_workers(self, authority: PublicKey) -> dict[WorkerId, WorkerInfo]:
+        return self.workers[authority]
+
+    def others_workers(
+        self, me: PublicKey, worker_id: WorkerId
+    ) -> list[tuple[PublicKey, WorkerInfo]]:
+        """Same-id workers at every other authority
+        (/root/reference/config/src/lib.rs:432-450)."""
+        return [
+            (pk, ws[worker_id])
+            for pk, ws in self.workers.items()
+            if pk != me and worker_id in ws
+        ]
+
+    def all_workers(self) -> list[WorkerInfo]:
+        return [w for ws in self.workers.values() for w in ws.values()]
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "epoch": self.epoch,
+                "workers": {
+                    pk.hex(): {
+                        str(wid): {
+                            "name": w.name.hex(),
+                            "transactions": w.transactions,
+                            "worker_address": w.worker_address,
+                        }
+                        for wid, w in ws.items()
+                    }
+                    for pk, ws in self.workers.items()
+                },
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "WorkerCache":
+        data = json.loads(text)
+        return WorkerCache(
+            {
+                bytes.fromhex(pk): {
+                    int(wid): WorkerInfo(
+                        name=bytes.fromhex(w["name"]),
+                        transactions=w["transactions"],
+                        worker_address=w["worker_address"],
+                    )
+                    for wid, w in ws.items()
+                }
+                for pk, ws in data["workers"].items()
+            },
+            epoch=data["epoch"],
+        )
+
+    def export(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @staticmethod
+    def import_(path: str) -> "WorkerCache":
+        with open(path) as f:
+            return WorkerCache.from_json(f.read())
+
+
+class Shared:
+    """Hot-swappable holder, the SharedCommittee/SharedWorkerCache analog
+    (Arc<ArcSwap<_>>, /root/reference/config/src/lib.rs:358,485). In asyncio
+    a plain attribute swap is atomic."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def load(self):
+        return self.value
+
+    def swap(self, new):
+        self.value = new
+
+
+def get_available_port(host: str = "127.0.0.1") -> int:
+    """(/root/reference/config/src/utils.rs:9-33)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]

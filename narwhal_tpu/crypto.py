@@ -1,0 +1,162 @@
+"""Protocol and network cryptography.
+
+The reference selects its crypto by type alias over the fastcrypto traits
+(/root/reference/crypto/src/lib.rs:29-46): protocol keys = BLS12-381
+(aggregatable), network keys = ed25519, digests = blake2b-256. The comment at
+crypto/src/lib.rs:19-27 demands the codebase stay generic over the trait seam —
+that seam is exactly where a TPU batch-verifier plugs in.
+
+TPU-first redesign: the protocol scheme here is **ed25519 multi-signature**
+rather than BLS aggregation. Certificates carry a vector of ed25519 signatures
+aligned with a signer bitmap (the reference carries one aggregate BLS signature
+plus the same bitmap, /root/reference/types/src/primary.rs:386-644). Rationale:
+ed25519 verification batches perfectly onto wide SIMD/TPU lanes (independent
+double-scalar multiplications over a single curve), whereas BLS pairings are a
+poor fit for the MXU/VPU; the bandwidth cost (64 bytes/signer vs 48 total) is
+noise next to batch payloads. The verifier interface below is the pluggable
+seam: `set_batch_verifier` installs the TPU backend (narwhal_tpu.tpu.verifier)
+with the host OpenSSL path as the always-present fallback.
+
+Host primitives are OpenSSL-backed via the `cryptography` package (native
+speed); hashing is hashlib blake2b (native).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+DIGEST_LEN = 32
+PUBLIC_KEY_LEN = 32
+SIGNATURE_LEN = 64
+
+
+def blake2b_256(data: bytes) -> bytes:
+    """blake2b-256, the reference's digest everywhere (fastcrypto blake2b)."""
+    return hashlib.blake2b(data, digest_size=DIGEST_LEN).digest()
+
+
+@dataclass(frozen=True)
+class KeyPair:
+    """An ed25519 keypair. `public` is the 32-byte raw public key, which is
+    also the authority's protocol name (reference: PublicKey = BLS pubkey used
+    as the authority identifier throughout config/committee)."""
+
+    public: bytes
+    _private: Ed25519PrivateKey
+
+    @staticmethod
+    def generate() -> "KeyPair":
+        priv = Ed25519PrivateKey.generate()
+        return KeyPair(public=_raw_public(priv.public_key()), _private=priv)
+
+    @staticmethod
+    def from_seed(seed: bytes) -> "KeyPair":
+        """Deterministic keypair from a 32-byte seed (test fixtures; the
+        reference offers a seeded-RNG CommitteeFixture,
+        /root/reference/test_utils/src/lib.rs:602-793)."""
+        if len(seed) != 32:
+            seed = hashlib.blake2b(seed, digest_size=32).digest()
+        priv = Ed25519PrivateKey.from_private_bytes(seed)
+        return KeyPair(public=_raw_public(priv.public_key()), _private=priv)
+
+    def sign(self, message: bytes) -> bytes:
+        return self._private.sign(message)
+
+    def private_bytes(self) -> bytes:
+        from cryptography.hazmat.primitives import serialization as ser
+
+        return self._private.private_bytes(
+            ser.Encoding.Raw, ser.PrivateFormat.Raw, ser.NoEncryption()
+        )
+
+
+def _raw_public(pub: Ed25519PublicKey) -> bytes:
+    from cryptography.hazmat.primitives import serialization as ser
+
+    return pub.public_bytes(ser.Encoding.Raw, ser.PublicFormat.Raw)
+
+
+_PUB_CACHE: dict[bytes, Ed25519PublicKey] = {}
+
+
+def _pub(public_key: bytes) -> Ed25519PublicKey:
+    obj = _PUB_CACHE.get(public_key)
+    if obj is None:
+        obj = Ed25519PublicKey.from_public_bytes(public_key)
+        if len(_PUB_CACHE) < 1 << 16:
+            _PUB_CACHE[public_key] = obj
+    return obj
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """Single ed25519 verification (host path)."""
+    try:
+        _pub(public_key).verify(signature, message)
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Batch verification seam (the TPU offload boundary).
+#
+# A batch item is (public_key, message, signature). The installed backend
+# returns a list[bool] of the same length. The host fallback loops over
+# OpenSSL; the TPU backend (narwhal_tpu/tpu/verifier.py) coalesces items into
+# fixed-shape device batches. This mirrors the north-star seam: worker
+# quorum_waiter and primary Certificate::verify push verifies through here.
+# ---------------------------------------------------------------------------
+
+BatchItem = tuple[bytes, bytes, bytes]
+BatchVerifier = Callable[[Sequence[BatchItem]], list[bool]]
+
+
+def _host_batch_verify(items: Sequence[BatchItem]) -> list[bool]:
+    return [verify(pk, msg, sig) for pk, msg, sig in items]
+
+
+_batch_verifier: BatchVerifier = _host_batch_verify
+
+
+def set_batch_verifier(backend: BatchVerifier | None) -> None:
+    global _batch_verifier
+    _batch_verifier = backend if backend is not None else _host_batch_verify
+
+
+def batch_verify(items: Sequence[BatchItem]) -> list[bool]:
+    if not items:
+        return []
+    return _batch_verifier(items)
+
+
+class SignatureService:
+    """Async signing actor, mirroring fastcrypto's SignatureService used by
+    Header::new / Vote::new (/root/reference/types/src/primary.rs:130-148,
+    269-286). Signing is cheap on host, so this is a thin async wrapper that
+    preserves the reference's request/response shape."""
+
+    def __init__(self, keypair: KeyPair) -> None:
+        self._keypair = keypair
+
+    @property
+    def public(self) -> bytes:
+        return self._keypair.public
+
+    async def request_signature(self, digest: bytes) -> bytes:
+        return self._keypair.sign(digest)
+
+    def sign(self, digest: bytes) -> bytes:
+        return self._keypair.sign(digest)
+
+
+async def asleep0() -> None:
+    await asyncio.sleep(0)

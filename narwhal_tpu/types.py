@@ -1,0 +1,428 @@
+"""Wire types: Batch, Header, Vote, Certificate and inter-role messages.
+
+Reference data model: /root/reference/types/src/primary.rs:32-789 (Batch :32-73,
+Header :75-256, Vote :258-384, Certificate :386-644, message enums :646-789)
+and /root/reference/types/src/worker.rs:17-62.
+
+TPU-first deltas from the reference:
+  * Certificates carry an ed25519 signature *vector* + signer index list
+    instead of one aggregate BLS signature + roaring bitmap (see crypto.py for
+    the rationale); verification is a batch verify over the vote digests —
+    the exact shape the TPU verifier consumes.
+  * All digests are blake2b-256 of the canonical codec encoding, so the
+    reference's `serialized_batch_digest` zero-copy optimization
+    (/root/reference/types/src/worker.rs:44-62) holds by construction: hashing
+    the wire bytes IS hashing the batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import cached_property
+from typing import Iterable, Mapping
+
+from .codec import CodecError, Reader, Writer
+from .crypto import DIGEST_LEN, PUBLIC_KEY_LEN, SIGNATURE_LEN, blake2b_256, verify
+
+Digest = bytes  # 32 bytes
+PublicKey = bytes  # 32 bytes
+WorkerId = int
+Round = int
+Epoch = int
+
+
+class DagError(Exception):
+    """Protocol-level rejection, mirroring /root/reference/types/src/error.rs:46-93."""
+
+
+class InvalidEpoch(DagError):
+    pass
+
+
+class TooOld(DagError):
+    pass
+
+
+class InvalidSignatureError(DagError):
+    pass
+
+
+class QuorumNotReached(DagError):
+    pass
+
+
+class UnknownWorker(DagError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Batch
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Batch:
+    """A list of opaque transactions (/root/reference/types/src/primary.rs:32-73)."""
+
+    transactions: tuple[bytes, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.transactions, lambda w_, t: w_.bytes(t))
+
+    @staticmethod
+    def decode(r: Reader) -> "Batch":
+        return Batch(tuple(r.seq(lambda r_: r_.bytes())))
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.finish()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Batch":
+        r = Reader(data)
+        b = Batch.decode(r)
+        r.done()
+        return b
+
+    @cached_property
+    def digest(self) -> Digest:
+        return blake2b_256(self.to_bytes())
+
+    @property
+    def size_bytes(self) -> int:
+        return sum(len(t) for t in self.transactions)
+
+
+def serialized_batch_digest(wire_bytes: bytes) -> Digest:
+    """Digest a serialized batch without deserializing it — the worker receive
+    path optimization (/root/reference/types/src/worker.rs:44-62). Valid
+    because Batch.digest hashes exactly the canonical wire encoding."""
+    return blake2b_256(wire_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Header
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Header:
+    """A round-r proposal (/root/reference/types/src/primary.rs:75-256).
+
+    payload maps BatchDigest -> WorkerId; parents are certificate digests of
+    round r-1. The digest covers everything but the signature; the signature
+    covers the digest.
+    """
+
+    author: PublicKey
+    round: Round
+    epoch: Epoch
+    payload: Mapping[Digest, WorkerId]
+    parents: frozenset[Digest]
+    signature: bytes = b""
+
+    def _encode_core(self, w: Writer) -> None:
+        w.raw(self.author)
+        w.u64(self.round)
+        w.u64(self.epoch)
+        w.sorted_map(
+            dict(self.payload),
+            lambda w_, k: w_.raw(k),
+            lambda w_, v: w_.u32(v),
+        )
+        w.seq(sorted(self.parents), lambda w_, p: w_.raw(p))
+
+    @cached_property
+    def digest(self) -> Digest:
+        w = Writer()
+        self._encode_core(w)
+        return blake2b_256(w.finish())
+
+    def encode(self, w: Writer) -> None:
+        self._encode_core(w)
+        w.bytes(self.signature)
+
+    @staticmethod
+    def decode(r: Reader) -> "Header":
+        author = r.raw(PUBLIC_KEY_LEN)
+        rnd = r.u64()
+        epoch = r.u64()
+        payload = r.map(lambda r_: r_.raw(DIGEST_LEN), lambda r_: r_.u32())
+        parents = frozenset(r.seq(lambda r_: r_.raw(DIGEST_LEN)))
+        signature = r.bytes()
+        return Header(author, rnd, epoch, payload, parents, signature)
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.finish()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Header":
+        r = Reader(data)
+        h = Header.decode(r)
+        r.done()
+        return h
+
+    @staticmethod
+    def build(
+        author: PublicKey,
+        round: Round,
+        epoch: Epoch,
+        payload: Mapping[Digest, WorkerId],
+        parents: Iterable[Digest],
+        signer,
+    ) -> "Header":
+        """Reference Header::new signs via the SignatureService
+        (/root/reference/types/src/primary.rs:130-148)."""
+        h = Header(author, round, epoch, dict(payload), frozenset(parents))
+        return Header(
+            author, round, epoch, dict(payload), frozenset(parents), signer.sign(h.digest)
+        )
+
+    def verify(self, committee, worker_cache) -> None:
+        """Mirrors Header::verify (/root/reference/types/src/primary.rs:180-233):
+        epoch, authority known + has stake, worker ids valid, signature."""
+        if self.epoch != committee.epoch:
+            raise InvalidEpoch(f"header epoch {self.epoch} != {committee.epoch}")
+        if committee.stake(self.author) == 0:
+            raise DagError(f"unknown authority {self.author.hex()[:16]}")
+        for digest, worker_id in self.payload.items():
+            if not worker_cache.has_worker(self.author, worker_id):
+                raise UnknownWorker(f"worker {worker_id} not in cache")
+        if not verify(self.author, self.digest, self.signature):
+            raise InvalidSignatureError("bad header signature")
+
+
+# ---------------------------------------------------------------------------
+# Vote
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Vote:
+    """A signed endorsement of a header
+    (/root/reference/types/src/primary.rs:258-384). origin = header author,
+    author = the voter."""
+
+    header_digest: Digest
+    round: Round
+    epoch: Epoch
+    origin: PublicKey
+    author: PublicKey
+    signature: bytes = b""
+
+    def _encode_core(self, w: Writer) -> None:
+        w.raw(self.header_digest)
+        w.u64(self.round)
+        w.u64(self.epoch)
+        w.raw(self.origin)
+        w.raw(self.author)
+
+    @cached_property
+    def digest(self) -> Digest:
+        w = Writer()
+        self._encode_core(w)
+        return blake2b_256(w.finish())
+
+    def encode(self, w: Writer) -> None:
+        self._encode_core(w)
+        w.bytes(self.signature)
+
+    @staticmethod
+    def decode(r: Reader) -> "Vote":
+        return Vote(
+            r.raw(DIGEST_LEN),
+            r.u64(),
+            r.u64(),
+            r.raw(PUBLIC_KEY_LEN),
+            r.raw(PUBLIC_KEY_LEN),
+            r.bytes(),
+        )
+
+    @staticmethod
+    def for_header(header: "Header", author: PublicKey, signer) -> "Vote":
+        v = Vote(header.digest, header.round, header.epoch, header.author, author)
+        return Vote(
+            v.header_digest, v.round, v.epoch, v.origin, v.author, signer.sign(v.digest)
+        )
+
+    def verify(self, committee) -> None:
+        """Vote::verify (/root/reference/types/src/primary.rs:344-371)."""
+        if self.epoch != committee.epoch:
+            raise InvalidEpoch(f"vote epoch {self.epoch} != {committee.epoch}")
+        if committee.stake(self.author) == 0:
+            raise DagError(f"unknown voter {self.author.hex()[:16]}")
+        if not verify(self.author, self.digest, self.signature):
+            raise InvalidSignatureError("bad vote signature")
+
+
+def vote_digest(
+    header_digest: Digest, round: Round, epoch: Epoch, origin: PublicKey, author: PublicKey
+) -> Digest:
+    """Digest a vote without constructing it — used by certificate batch
+    verification to rebuild each signer's signed message."""
+    w = Writer()
+    w.raw(header_digest)
+    w.u64(round)
+    w.u64(epoch)
+    w.raw(origin)
+    w.raw(author)
+    return blake2b_256(w.finish())
+
+
+# ---------------------------------------------------------------------------
+# Certificate
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Certificate:
+    """A header plus a quorum of votes
+    (/root/reference/types/src/primary.rs:386-644). The reference stores one
+    aggregate BLS signature + a roaring bitmap of signers; we store the signer
+    committee-indices (sorted) and the matching ed25519 vote signatures —
+    batch-verifiable in one TPU call. The certificate digest depends only on
+    the header (as in the reference), so certificates assembled from different
+    vote subsets dedup to the same identity."""
+
+    header: Header
+    signers: tuple[int, ...] = ()
+    signatures: tuple[bytes, ...] = ()
+
+    @property
+    def round(self) -> Round:
+        return self.header.round
+
+    @property
+    def epoch(self) -> Epoch:
+        return self.header.epoch
+
+    @property
+    def origin(self) -> PublicKey:
+        return self.header.author
+
+    @cached_property
+    def digest(self) -> Digest:
+        w = Writer()
+        w.raw(b"CERT")
+        w.raw(self.header.digest)
+        return blake2b_256(w.finish())
+
+    def encode(self, w: Writer) -> None:
+        self.header.encode(w)
+        w.seq(self.signers, lambda w_, i: w_.u32(i))
+        w.seq(self.signatures, lambda w_, s: w_.raw(s))
+
+    @staticmethod
+    def decode(r: Reader) -> "Certificate":
+        header = Header.decode(r)
+        signers = tuple(r.seq(lambda r_: r_.u32()))
+        sigs = tuple(r.seq(lambda r_: r_.raw(SIGNATURE_LEN)))
+        return Certificate(header, signers, sigs)
+
+    def to_bytes(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.finish()
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Certificate":
+        r = Reader(data)
+        c = Certificate.decode(r)
+        r.done()
+        return c
+
+    @staticmethod
+    def genesis(committee) -> list["Certificate"]:
+        """One empty certificate per authority at round 0
+        (/root/reference/types/src/primary.rs:402-420)."""
+        return [
+            Certificate(
+                Header(author=pk, round=0, epoch=committee.epoch, payload={}, parents=frozenset())
+            )
+            for pk in committee.authorities
+        ]
+
+    def is_genesis(self) -> bool:
+        return self.round == 0
+
+    def verify_items(self, committee) -> list[tuple[bytes, bytes, bytes]]:
+        """Structural checks + return the (pubkey, message, signature) batch
+        to verify. Mirrors Certificate::verify
+        (/root/reference/types/src/primary.rs:487-537): epoch, quorum stake of
+        signers, then the signature check — here a batch of per-voter ed25519
+        verifies instead of one aggregate-verify."""
+        if self.epoch != committee.epoch:
+            raise InvalidEpoch(f"certificate epoch {self.epoch} != {committee.epoch}")
+        if self.is_genesis():
+            if self not in Certificate.genesis(committee):
+                raise DagError("malformed genesis certificate")
+            return []
+        if len(self.signers) != len(self.signatures):
+            raise DagError("signer/signature arity mismatch")
+        if len(set(self.signers)) != len(self.signers):
+            raise DagError("duplicate signers")
+        keys = committee.authority_keys()
+        stake = 0
+        items = []
+        for idx, sig in zip(self.signers, self.signatures):
+            if idx >= len(keys):
+                raise DagError(f"signer index {idx} out of range")
+            pk = keys[idx]
+            stake += committee.stake(pk)
+            msg = vote_digest(
+                self.header.digest, self.round, self.epoch, self.origin, pk
+            )
+            items.append((pk, msg, sig))
+        if stake < committee.quorum_threshold():
+            raise QuorumNotReached(
+                f"certificate carries {stake} stake < quorum {committee.quorum_threshold()}"
+            )
+        return items
+
+    def verify(self, committee, worker_cache) -> None:
+        items = self.verify_items(committee)
+        if not items:
+            return
+        self.header.verify(committee, worker_cache)
+        from .crypto import batch_verify
+
+        if not all(batch_verify(items)):
+            raise InvalidSignatureError("certificate vote signature invalid")
+
+    # DAG affiliation (reference: Affiliated for Certificate,
+    # /root/reference/types/src/primary.rs:633-644): parents are hash
+    # pointers; certificates with empty payload are compressible.
+    def parent_digests(self) -> frozenset[Digest]:
+        return self.header.parents
+
+    def compressible(self) -> bool:
+        return not self.header.payload
+
+
+# ---------------------------------------------------------------------------
+# Consensus output / sequence numbers
+# ---------------------------------------------------------------------------
+
+SequenceNumber = int
+
+
+@dataclass(frozen=True)
+class ConsensusOutput:
+    """An ordered certificate with its global consensus index
+    (/root/reference/types/src/consensus.rs:14-40)."""
+
+    certificate: Certificate
+    consensus_index: SequenceNumber
+
+
+@dataclass(frozen=True)
+class ReconfigureNotification:
+    """Committee change / shutdown broadcast on the reconfigure watch channel
+    (/root/reference/types/src/primary.rs:646-668 ReconfigureNotification).
+    kind: 'new_epoch' | 'update_committee' | 'shutdown'."""
+
+    kind: str
+    committee: object | None = None

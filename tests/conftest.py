@@ -1,0 +1,24 @@
+import os
+
+# Tests exercise multi-device sharding on a virtual 8-device CPU mesh; the
+# real TPU chip is reserved for bench.py. Must be set before jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio
+
+import pytest
+
+
+@pytest.fixture
+def run():
+    """Run a coroutine to completion on a fresh event loop."""
+
+    def _run(coro, timeout=30.0):
+        return asyncio.run(asyncio.wait_for(coro, timeout))
+
+    return _run

@@ -1,0 +1,45 @@
+"""Wire-format stability snapshot, mirroring
+/root/reference/node/tests/formats.rs:5 + node/src/generate_format.rs: the
+canonical encodings and digests of fixed objects must never drift silently.
+If a format change is intentional, update the snapshots below in the same
+commit and call it out in the message."""
+
+from narwhal_tpu.fixtures import CommitteeFixture
+from narwhal_tpu.types import Batch, Certificate
+
+# Deterministic fixture: seeded keypairs => stable keys, digests, signatures
+# are deterministic for ed25519 (RFC 8032).
+F = CommitteeFixture(size=4, seed=0)
+
+
+def test_batch_format_snapshot():
+    b = Batch((b"alpha", b"beta"))
+    assert b.to_bytes().hex() == (
+        "02000000" "05000000" + b"alpha".hex() + "04000000" + b"beta".hex()
+    )
+    assert b.digest.hex() == (
+        "8a208d6b5ef9b60be4f1892f4473263b7269acede8a87f0392d7e5b405be211a"
+    )
+
+
+def test_header_format_snapshot():
+    h = F.header(author=0, round=1)
+    assert h.digest.hex() == (
+        "addfc7891231ba34c589408397e9eb24720e15a1b52a688b768e6b6b6bb5046e"
+    )
+    # author (32B raw) + round + epoch + empty payload map + 4 genesis parents
+    wire = h.to_bytes()
+    assert wire[:32] == h.author
+    assert wire[32:40] == (1).to_bytes(8, "little")
+    assert wire[40:48] == (0).to_bytes(8, "little")
+
+
+def test_certificate_format_snapshot():
+    gen = Certificate.genesis(F.committee)
+    digests = sorted(c.digest.hex() for c in gen)
+    assert digests[0] == (
+        "00a62328a6f7077216d6b07d87ae074973adbecb3360df41116d047cfe8c2393"
+    )
+    cert = F.certificate(F.header(author=0, round=1))
+    rt = Certificate.from_bytes(cert.to_bytes())
+    assert rt == cert
