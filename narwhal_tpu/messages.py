@@ -1,0 +1,497 @@
+"""Inter-role RPC messages with a tag registry.
+
+Reference message enums: PrimaryMessage / PrimaryWorkerMessage /
+WorkerPrimaryMessage (/root/reference/types/src/primary.rs:646-789) and the
+worker<->worker plane (/root/reference/types/src/worker.rs:17-32), carried by
+anemo services generated in /root/reference/types/build.rs:42-121.
+
+Every message is a dataclass with a unique TAG, canonical encode/decode, and
+is registered for the RPC layer's dispatch. Reliable sends are acked request/
+response pairs (the anemo RPC analog); messages that expect data back define a
+response type.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .codec import Reader, Writer
+from .config import Committee
+from .crypto import DIGEST_LEN, PUBLIC_KEY_LEN
+from .types import Batch, Certificate, Digest, Header, PublicKey, Round, Vote, WorkerId
+
+REGISTRY: dict[int, type] = {}
+
+
+def message(tag: int):
+    def deco(cls):
+        assert tag not in REGISTRY, f"duplicate message tag {tag}"
+        cls.TAG = tag
+        REGISTRY[tag] = cls
+        return cls
+
+    return deco
+
+
+def encode_message(msg) -> tuple[int, bytes]:
+    w = Writer()
+    msg.encode(w)
+    return msg.TAG, w.finish()
+
+
+def decode_message(tag: int, body: bytes):
+    cls = REGISTRY.get(tag)
+    if cls is None:
+        raise ValueError(f"unknown message tag {tag}")
+    r = Reader(body)
+    msg = cls.decode(r)
+    r.done()
+    return msg
+
+
+def _enc_digest(w: Writer, d: Digest) -> None:
+    w.raw(d)
+
+
+def _dec_digest(r: Reader) -> Digest:
+    return r.raw(DIGEST_LEN)
+
+
+# ---------------------------------------------------------------------------
+# Generic
+# ---------------------------------------------------------------------------
+
+
+@message(0)
+@dataclass
+class Ack:
+    """Empty reliable-delivery acknowledgment."""
+
+    def encode(self, w: Writer) -> None:
+        pass
+
+    @staticmethod
+    def decode(r: Reader) -> "Ack":
+        return Ack()
+
+
+# ---------------------------------------------------------------------------
+# Primary <-> Primary (types/src/primary.rs:646-700)
+# ---------------------------------------------------------------------------
+
+
+@message(1)
+@dataclass
+class HeaderMsg:
+    header: Header
+
+    def encode(self, w: Writer) -> None:
+        self.header.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "HeaderMsg":
+        return HeaderMsg(Header.decode(r))
+
+
+@message(2)
+@dataclass
+class VoteMsg:
+    vote: Vote
+
+    def encode(self, w: Writer) -> None:
+        self.vote.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "VoteMsg":
+        return VoteMsg(Vote.decode(r))
+
+
+@message(3)
+@dataclass
+class CertificateMsg:
+    certificate: Certificate
+
+    def encode(self, w: Writer) -> None:
+        self.certificate.encode(w)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificateMsg":
+        return CertificateMsg(Certificate.decode(r))
+
+
+@message(4)
+@dataclass
+class CertificatesRequest:
+    """Ask a peer for specific certificates; peer replies with loose
+    CertificateMsg sends (reference PrimaryMessage::CertificatesRequest,
+    helper.rs:82-99)."""
+
+    digests: tuple[Digest, ...]
+    requestor: PublicKey
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+        w.raw(self.requestor)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificatesRequest":
+        return CertificatesRequest(
+            tuple(r.seq(_dec_digest)), r.raw(PUBLIC_KEY_LEN)
+        )
+
+
+@message(5)
+@dataclass
+class CertificatesBatchRequest:
+    """Block-synchronizer bulk fetch; RPC with CertificatesBatchResponse."""
+
+    digests: tuple[Digest, ...]
+    requestor: PublicKey = b"\0" * 32
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+        w.raw(self.requestor)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificatesBatchRequest":
+        return CertificatesBatchRequest(tuple(r.seq(_dec_digest)), r.raw(PUBLIC_KEY_LEN))
+
+
+@message(6)
+@dataclass
+class CertificatesBatchResponse:
+    """(digest, certificate|None) pairs (reference CertificateDigestsResponse)."""
+
+    certificates: tuple[tuple[Digest, Certificate | None], ...]
+
+    def encode(self, w: Writer) -> None:
+        def enc(w_: Writer, item) -> None:
+            digest, cert = item
+            w_.raw(digest)
+            if cert is None:
+                w_.u8(0)
+            else:
+                w_.u8(1)
+                cert.encode(w_)
+
+        w.seq(self.certificates, enc)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificatesBatchResponse":
+        def dec(r_: Reader):
+            digest = _dec_digest(r_)
+            return (digest, Certificate.decode(r_) if r_.u8() else None)
+
+        return CertificatesBatchResponse(tuple(r.seq(dec)))
+
+
+@message(7)
+@dataclass
+class CertificatesRangeRequest:
+    """Catch-up: digests of certificates in rounds (from, to] per authority
+    (block_synchronizer SynchronizeRange)."""
+
+    from_round: Round
+    to_round: Round
+    requestor: PublicKey = b"\0" * 32
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.from_round)
+        w.u64(self.to_round)
+        w.raw(self.requestor)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificatesRangeRequest":
+        return CertificatesRangeRequest(r.u64(), r.u64(), r.raw(PUBLIC_KEY_LEN))
+
+
+@message(8)
+@dataclass
+class CertificatesRangeResponse:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificatesRangeResponse":
+        return CertificatesRangeResponse(tuple(r.seq(_dec_digest)))
+
+
+@message(9)
+@dataclass
+class PayloadAvailabilityRequest:
+    digests: tuple[Digest, ...]
+    requestor: PublicKey = b"\0" * 32
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+        w.raw(self.requestor)
+
+    @staticmethod
+    def decode(r: Reader) -> "PayloadAvailabilityRequest":
+        return PayloadAvailabilityRequest(tuple(r.seq(_dec_digest)), r.raw(PUBLIC_KEY_LEN))
+
+
+@message(10)
+@dataclass
+class PayloadAvailabilityResponse:
+    available: tuple[tuple[Digest, bool], ...]
+
+    def encode(self, w: Writer) -> None:
+        def enc(w_: Writer, item) -> None:
+            w_.raw(item[0])
+            w_.u8(1 if item[1] else 0)
+
+        w.seq(self.available, enc)
+
+    @staticmethod
+    def decode(r: Reader) -> "PayloadAvailabilityResponse":
+        def dec(r_: Reader):
+            return (_dec_digest(r_), bool(r_.u8()))
+
+        return PayloadAvailabilityResponse(tuple(r.seq(dec)))
+
+
+# ---------------------------------------------------------------------------
+# Primary -> Worker (types/src/primary.rs:702-750)
+# ---------------------------------------------------------------------------
+
+
+@message(20)
+@dataclass
+class SynchronizeMsg:
+    """Fetch these batches from the target authority's same-id worker
+    (worker/src/synchronizer.rs:77-384)."""
+
+    digests: tuple[Digest, ...]
+    target: PublicKey
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+        w.raw(self.target)
+
+    @staticmethod
+    def decode(r: Reader) -> "SynchronizeMsg":
+        return SynchronizeMsg(tuple(r.seq(_dec_digest)), r.raw(PUBLIC_KEY_LEN))
+
+
+@message(21)
+@dataclass
+class CleanupMsg:
+    round: Round
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.round)
+
+    @staticmethod
+    def decode(r: Reader) -> "CleanupMsg":
+        return CleanupMsg(r.u64())
+
+
+@message(22)
+@dataclass
+class RequestBatchMsg:
+    digest: Digest
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "RequestBatchMsg":
+        return RequestBatchMsg(_dec_digest(r))
+
+
+@message(23)
+@dataclass
+class DeleteBatchesMsg:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "DeleteBatchesMsg":
+        return DeleteBatchesMsg(tuple(r.seq(_dec_digest)))
+
+
+@message(24)
+@dataclass
+class ReconfigureMsg:
+    """kind: 'new_epoch' | 'update_committee' | 'shutdown'; committee as JSON
+    (ReconfigureNotification, types/src/primary.rs:646-668)."""
+
+    kind: str
+    committee_json: str = ""
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.kind.encode())
+        w.bytes(self.committee_json.encode())
+
+    @staticmethod
+    def decode(r: Reader) -> "ReconfigureMsg":
+        return ReconfigureMsg(r.bytes().decode(), r.bytes().decode())
+
+    def committee(self) -> Committee | None:
+        return Committee.from_json(self.committee_json) if self.committee_json else None
+
+
+# ---------------------------------------------------------------------------
+# Worker -> Primary (types/src/worker.rs WorkerPrimaryMessage)
+# ---------------------------------------------------------------------------
+
+
+@message(30)
+@dataclass
+class OurBatchMsg:
+    digest: Digest
+    worker_id: WorkerId
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.digest)
+        w.u32(self.worker_id)
+
+    @staticmethod
+    def decode(r: Reader) -> "OurBatchMsg":
+        return OurBatchMsg(_dec_digest(r), r.u32())
+
+
+@message(31)
+@dataclass
+class OthersBatchMsg:
+    digest: Digest
+    worker_id: WorkerId
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.digest)
+        w.u32(self.worker_id)
+
+    @staticmethod
+    def decode(r: Reader) -> "OthersBatchMsg":
+        return OthersBatchMsg(_dec_digest(r), r.u32())
+
+
+@message(32)
+@dataclass
+class RequestedBatchMsg:
+    digest: Digest
+    transactions: tuple[bytes, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.digest)
+        w.seq(self.transactions, lambda w_, t: w_.bytes(t))
+
+    @staticmethod
+    def decode(r: Reader) -> "RequestedBatchMsg":
+        return RequestedBatchMsg(_dec_digest(r), tuple(r.seq(lambda r_: r_.bytes())))
+
+
+@message(33)
+@dataclass
+class DeletedBatchesMsg:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "DeletedBatchesMsg":
+        return DeletedBatchesMsg(tuple(r.seq(_dec_digest)))
+
+
+@message(34)
+@dataclass
+class WorkerErrorMsg:
+    error: str
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.error.encode())
+
+    @staticmethod
+    def decode(r: Reader) -> "WorkerErrorMsg":
+        return WorkerErrorMsg(r.bytes().decode())
+
+
+# ---------------------------------------------------------------------------
+# Worker <-> Worker (types/src/worker.rs:17-32)
+# ---------------------------------------------------------------------------
+
+
+@message(40)
+@dataclass
+class WorkerBatchMsg:
+    """Batch dissemination. Carries the serialized batch so the receiver can
+    digest the wire bytes directly (serialized_batch_digest,
+    types/src/worker.rs:44-62)."""
+
+    serialized_batch: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.serialized_batch)
+
+    @staticmethod
+    def decode(r: Reader) -> "WorkerBatchMsg":
+        return WorkerBatchMsg(r.bytes())
+
+    def batch(self) -> Batch:
+        return Batch.from_bytes(self.serialized_batch)
+
+
+@message(41)
+@dataclass
+class WorkerBatchRequest:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "WorkerBatchRequest":
+        return WorkerBatchRequest(tuple(r.seq(_dec_digest)))
+
+
+@message(42)
+@dataclass
+class WorkerBatchResponse:
+    batches: tuple[bytes, ...]  # serialized batches
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.batches, lambda w_, b: w_.bytes(b))
+
+    @staticmethod
+    def decode(r: Reader) -> "WorkerBatchResponse":
+        return WorkerBatchResponse(tuple(r.seq(lambda r_: r_.bytes())))
+
+
+# ---------------------------------------------------------------------------
+# Client -> Worker transactions (the tonic Transactions service analog,
+# worker/src/worker.rs:352-423)
+# ---------------------------------------------------------------------------
+
+
+@message(50)
+@dataclass
+class SubmitTransactionMsg:
+    transaction: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.transaction)
+
+    @staticmethod
+    def decode(r: Reader) -> "SubmitTransactionMsg":
+        return SubmitTransactionMsg(r.bytes())
+
+
+@message(51)
+@dataclass
+class SubmitTransactionStreamMsg:
+    """Batched client submission (the streaming variant)."""
+
+    transactions: tuple[bytes, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.transactions, lambda w_, t: w_.bytes(t))
+
+    @staticmethod
+    def decode(r: Reader) -> "SubmitTransactionStreamMsg":
+        return SubmitTransactionStreamMsg(tuple(r.seq(lambda r_: r_.bytes())))

@@ -1,0 +1,15 @@
+from .rpc import (
+    NetworkClient,
+    PeerClient,
+    RetryConfig,
+    RpcError,
+    RpcServer,
+)
+
+__all__ = [
+    "NetworkClient",
+    "PeerClient",
+    "RetryConfig",
+    "RpcError",
+    "RpcServer",
+]

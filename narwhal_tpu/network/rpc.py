@@ -1,0 +1,326 @@
+"""Asyncio TCP RPC mesh — the validator-internal communication backend.
+
+Reference: anemo QUIC with bincode codec wrapped by P2pNetwork
+(/root/reference/network/src/p2p.rs:26-360) offering unreliable_send
+(fire-once), send (retry forever with exponential backoff, cancel-on-drop)
+and broadcast/lucky_broadcast policies (/root/reference/network/src/traits.rs:10-94),
+with per-peer BoundedExecutor concurrency caps
+(/root/reference/network/src/bounded_executor.rs:46-153) and RetryConfig
+(/root/reference/network/src/retry.rs:9-60).
+
+TPU-native deployment keeps this plane on the host NIC (DCN/ethernet): BFT
+messages must stay per-validator-signed point-to-point — ICI collectives are
+trust-free only inside one operator's pod (SURVEY §5.9). Transport is
+length-prefixed frames over TCP with persistent auto-reconnecting peer
+connections; every send is an acked request/response, so reliable-send stake
+counting (QuorumWaiter) works exactly as in the reference.
+
+Frame layout: u32 body_len | u8 kind(REQ/RESP/ERR) | u64 request_id |
+u16 msg_tag | payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import random
+import struct
+from typing import Awaitable, Callable, Iterable
+
+from ..channels import CancelOnDrop
+from ..messages import Ack, decode_message, encode_message
+
+logger = logging.getLogger("narwhal.network")
+
+_FRAME_HDR = struct.Struct("<IBQH")  # len, kind, rid, tag
+KIND_REQ = 0
+KIND_RESP = 1
+KIND_ERR = 2
+
+MAX_FRAME = 64 << 20  # 64 MiB, > max batch size with generous headroom
+MAX_TASK_CONCURRENCY = 500  # per-peer cap (network/src/lib.rs:54)
+
+
+class RpcError(Exception):
+    pass
+
+
+class RetryConfig:
+    """Exponential backoff (network/src/retry.rs:9-60). max_elapsed=None
+    retries forever (the reliable-send policy, p2p.rs:37-41)."""
+
+    def __init__(
+        self,
+        initial: float = 0.05,
+        multiplier: float = 1.5,
+        max_interval: float = 5.0,
+        max_elapsed: float | None = 30.0,
+        jitter: float = 0.1,
+    ):
+        self.initial = initial
+        self.multiplier = multiplier
+        self.max_interval = max_interval
+        self.max_elapsed = max_elapsed
+        self.jitter = jitter
+
+    def delays(self):
+        delay = self.initial
+        elapsed = 0.0
+        while True:
+            d = delay * (1.0 + random.uniform(-self.jitter, self.jitter))
+            yield d
+            elapsed += d
+            if self.max_elapsed is not None and elapsed >= self.max_elapsed:
+                return
+            delay = min(delay * self.multiplier, self.max_interval)
+
+
+def _pack(kind: int, rid: int, tag: int, body: bytes) -> bytes:
+    return _FRAME_HDR.pack(len(body), kind, rid, tag) + body
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, int, int, bytes]:
+    hdr = await reader.readexactly(_FRAME_HDR.size)
+    length, kind, rid, tag = _FRAME_HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame of {length} bytes exceeds cap")
+    body = await reader.readexactly(length) if length else b""
+    return kind, rid, tag, body
+
+
+class PeerClient:
+    """Persistent connection to one peer address with request/response
+    correlation and lazy reconnect."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._writer: asyncio.StreamWriter | None = None
+        self._reader_task: asyncio.Task | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._rid = itertools.count(1)
+        self._lock = asyncio.Lock()
+
+    async def _connect(self) -> None:
+        async with self._lock:
+            if self._writer is not None:
+                return
+            host, port = self.address.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port), limit=MAX_FRAME + 1024)
+            self._writer = writer
+            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                kind, rid, tag, body = await _read_frame(reader)
+                fut = self._pending.pop(rid, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == KIND_RESP:
+                    try:
+                        fut.set_result(decode_message(tag, body))
+                    except Exception as e:  # decode error
+                        fut.set_exception(RpcError(str(e)))
+                elif kind == KIND_ERR:
+                    fut.set_exception(RpcError(body.decode(errors="replace")))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError):
+            pass
+        finally:
+            self._teardown(RpcError(f"connection to {self.address} lost"))
+
+    def _teardown(self, exc: Exception) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._writer = None
+        self._reader_task = None
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def request(self, msg, timeout: float | None = 10.0):
+        """Send a request frame, await the peer's response (Ack for oneway
+        handlers). Raises RpcError/OSError on transport failure."""
+        if self._writer is None:
+            await self._connect()
+        rid = next(self._rid)
+        tag, body = encode_message(msg)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            self._writer.write(_pack(KIND_REQ, rid, tag, body))
+            await self._writer.drain()
+            return await asyncio.wait_for(fut, timeout)
+        except (ConnectionError, OSError) as e:
+            self._pending.pop(rid, None)
+            self._teardown(RpcError(str(e)))
+            raise RpcError(f"send to {self.address} failed: {e}") from e
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            raise RpcError(f"request to {self.address} timed out")
+
+    def close(self) -> None:
+        self._teardown(RpcError("client closed"))
+
+
+Handler = Callable[[object, str], Awaitable[object | None]]
+
+
+class RpcServer:
+    """Listens for peers and dispatches requests to handlers by message tag.
+
+    Handlers receive (message, peer_addr) and return a response message or
+    None (=> Ack). Handler exceptions become ERR frames, like anemo's status
+    responses. Concurrency is bounded per connection."""
+
+    def __init__(self, max_concurrency: int = MAX_TASK_CONCURRENCY):
+        self._handlers: dict[int, Handler] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._max_concurrency = max_concurrency
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    def route(self, msg_cls, handler: Handler) -> None:
+        self._handlers[msg_cls.TAG] = handler
+
+    async def start(self, host: str, port: int) -> int:
+        self._server = await asyncio.start_server(
+            self._on_connection, host, port, limit=MAX_FRAME + 1024
+        )
+        return self._server.sockets[0].getsockname()[1]
+
+    @property
+    def port(self) -> int:
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        peer_addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        sem = asyncio.Semaphore(self._max_concurrency)
+        tasks: set[asyncio.Task] = set()
+        try:
+            while True:
+                kind, rid, tag, body = await _read_frame(reader)
+                if kind != KIND_REQ:
+                    continue
+                await sem.acquire()
+                t = asyncio.ensure_future(
+                    self._dispatch(writer, rid, tag, body, peer_addr)
+                )
+                tasks.add(t)
+                t.add_done_callback(lambda t_: (tasks.discard(t_), sem.release()))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError):
+            pass
+        finally:
+            for t in tasks:
+                t.cancel()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(
+        self, writer: asyncio.StreamWriter, rid: int, tag: int, body: bytes, peer: str
+    ) -> None:
+        try:
+            handler = self._handlers.get(tag)
+            if handler is None:
+                raise RpcError(f"no handler for tag {tag}")
+            msg = decode_message(tag, body)
+            resp = await handler(msg, peer)
+            if resp is None:
+                resp = Ack()
+            rtag, rbody = encode_message(resp)
+            frame = _pack(KIND_RESP, rid, rtag, rbody)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            frame = _pack(KIND_ERR, rid, 0, str(e).encode())
+        try:
+            writer.write(frame)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+class NetworkClient:
+    """The P2pNetwork facade (/root/reference/network/src/p2p.rs:26-158):
+    cached per-peer clients + the three send policies."""
+
+    def __init__(self, retry: RetryConfig | None = None):
+        self._peers: dict[str, PeerClient] = {}
+        self._retry = retry or RetryConfig(max_elapsed=None)
+        self._send_tasks: set[asyncio.Task] = set()
+
+    def peer(self, address: str) -> PeerClient:
+        client = self._peers.get(address)
+        if client is None:
+            client = PeerClient(address)
+            self._peers[address] = client
+        return client
+
+    async def request(self, address: str, msg, timeout: float | None = 10.0):
+        """One attempt RPC with a typed response."""
+        return await self.peer(address).request(msg, timeout)
+
+    async def unreliable_send(self, address: str, msg, timeout: float | None = 5.0) -> bool:
+        """Fire once; True iff delivered+acked (UnreliableNetwork,
+        traits.rs:10-40)."""
+        try:
+            await self.peer(address).request(msg, timeout)
+            return True
+        except (RpcError, OSError):
+            return False
+
+    def send(self, address: str, msg, timeout: float | None = 10.0) -> CancelOnDrop:
+        """Reliable send: background task retrying forever with backoff until
+        the peer acks; returns a cancellable handle whose await yields True
+        (ReliableNetwork, traits.rs:42-94 + p2p.rs:37-41)."""
+
+        async def attempt_forever():
+            delays = self._retry.delays()
+            while True:
+                try:
+                    await self.peer(address).request(msg, timeout)
+                    return True
+                except (RpcError, OSError) as e:
+                    try:
+                        delay = next(delays)
+                    except StopIteration:
+                        raise RpcError(f"retries to {address} exhausted: {e}") from e
+                    await asyncio.sleep(delay)
+
+        task = asyncio.ensure_future(attempt_forever())
+        self._send_tasks.add(task)
+        task.add_done_callback(self._send_tasks.discard)
+        return CancelOnDrop(task)
+
+    def broadcast(self, addresses: Iterable[str], msg) -> list[CancelOnDrop]:
+        return [self.send(a, msg) for a in addresses]
+
+    async def unreliable_broadcast(self, addresses: Iterable[str], msg) -> list[bool]:
+        return list(
+            await asyncio.gather(*(self.unreliable_send(a, msg) for a in addresses))
+        )
+
+    async def lucky_broadcast(self, addresses: list[str], msg, nodes: int) -> list[bool]:
+        """Random-subset broadcast (LuckyNetwork, traits.rs:70-94)."""
+        chosen = random.sample(addresses, min(nodes, len(addresses)))
+        return await self.unreliable_broadcast(chosen, msg)
+
+    def close(self) -> None:
+        for t in self._send_tasks:
+            t.cancel()
+        for p in self._peers.values():
+            p.close()
+        self._peers.clear()

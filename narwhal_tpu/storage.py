@@ -1,0 +1,242 @@
+"""Persistent storage: WAL-backed column-family store with notify_read.
+
+The reference persists everything in RocksDB through the typed-store crate:
+9 column families opened at /root/reference/node/src/lib.rs:53-123, a generic
+Store<K,V> with read/write/remove/notify_read/iter, and a CertificateStore
+with a (round, digest) secondary index plus a blocking notify_read pub/sub
+(/root/reference/storage/src/certificate_store.rs:28-331) — the primitive all
+"waiter" components are built on.
+
+TPU-native design: node state is small (digests, headers, certs — payload
+batches are the only bulk data), so we use an in-memory hash table per column
+family backed by an append-only write-ahead log for durability. Recovery
+replays the WAL; a torn tail record is discarded, giving atomic write_batch.
+This trades RocksDB's compaction machinery for zero-dependency simplicity;
+`compact()` rewrites the log when garbage exceeds a threshold (GC deletes
+from consensus would otherwise grow it unboundedly).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+import zlib
+from typing import Iterable, Iterator
+
+_HDR = struct.Struct("<II")  # payload_len, crc32
+
+
+class StorageEngine:
+    """One per node, holding every column family (the RocksDB instance
+    analog). path=None runs purely in memory (tests)."""
+
+    def __init__(self, path: str | None):
+        self._path = path
+        self._cfs: dict[str, "ColumnFamily"] = {}
+        self._log = None
+        self._cf_ids: dict[str, int] = {}
+        self._dirty_bytes = 0
+        self._append_count = 0
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._log_path = os.path.join(path, "wal.log")
+            self._replay()
+            self._log = open(self._log_path, "ab")
+
+    def column_family(self, name: str) -> "ColumnFamily":
+        cf = self._cfs.get(name)
+        if cf is None:
+            cf = ColumnFamily(name, self)
+            self._cfs[name] = cf
+            self._cf_ids.setdefault(name, len(self._cf_ids))
+        return cf
+
+    # -- WAL --------------------------------------------------------------
+    def _replay(self) -> None:
+        if not os.path.exists(self._log_path):
+            return
+        with open(self._log_path, "rb") as f:
+            data = f.read()
+        pos = 0
+        valid_end = 0
+        while pos + _HDR.size <= len(data):
+            plen, crc = _HDR.unpack_from(data, pos)
+            body_end = pos + _HDR.size + plen
+            if body_end > len(data):
+                break
+            body = data[pos + _HDR.size : body_end]
+            if zlib.crc32(body) != crc:
+                break
+            self._apply_record(body)
+            pos = body_end
+            valid_end = pos
+        if valid_end < len(data):
+            # torn tail: truncate so future appends start at a clean boundary
+            with open(self._log_path, "ab") as f:
+                f.truncate(valid_end)
+
+    def _apply_record(self, body: bytes) -> None:
+        pos = 0
+        (count,) = struct.unpack_from("<I", body, pos)
+        pos += 4
+        for _ in range(count):
+            op, name_len = struct.unpack_from("<BH", body, pos)
+            pos += 3
+            name = body[pos : pos + name_len].decode()
+            pos += name_len
+            (klen,) = struct.unpack_from("<I", body, pos)
+            pos += 4
+            key = body[pos : pos + klen]
+            pos += klen
+            cf = self.column_family(name)
+            if op == 0:
+                (vlen,) = struct.unpack_from("<I", body, pos)
+                pos += 4
+                value = body[pos : pos + vlen]
+                pos += vlen
+                cf._data[key] = value
+            else:
+                cf._data.pop(key, None)
+
+    def _append(self, ops: list[tuple[int, str, bytes, bytes]]) -> None:
+        if self._log is None:
+            return
+        parts = [struct.pack("<I", len(ops))]
+        for op, name, key, value in ops:
+            nb = name.encode()
+            parts.append(struct.pack("<BH", op, len(nb)))
+            parts.append(nb)
+            parts.append(struct.pack("<I", len(key)))
+            parts.append(key)
+            if op == 0:
+                parts.append(struct.pack("<I", len(value)))
+                parts.append(value)
+        body = b"".join(parts)
+        self._log.write(_HDR.pack(len(body), zlib.crc32(body)) + body)
+        self._log.flush()
+        self._dirty_bytes += len(body)
+        self._append_count += 1
+        # Compaction check is amortized: only every 4096 appends, and only
+        # once the log is large, do we pay for a live-size scan.
+        if self._dirty_bytes > (64 << 20) and self._append_count % 4096 == 0:
+            if self._dirty_bytes > 2 * self._live_size_estimate():
+                self.compact()
+
+    def _live_size_estimate(self) -> int:
+        return sum(
+            sum(len(k) + len(v) for k, v in cf._data.items())
+            for cf in self._cfs.values()
+        )
+
+    def compact(self) -> None:
+        """Rewrite the WAL with only live entries."""
+        if self._log is None:
+            return
+        tmp = self._log_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for cf in self._cfs.values():
+                for key, value in cf._data.items():
+                    nb = cf.name.encode()
+                    body = (
+                        struct.pack("<I", 1)
+                        + struct.pack("<BH", 0, len(nb))
+                        + nb
+                        + struct.pack("<I", len(key))
+                        + key
+                        + struct.pack("<I", len(value))
+                        + value
+                    )
+                    f.write(_HDR.pack(len(body), zlib.crc32(body)) + body)
+        self._log.close()
+        os.replace(tmp, self._log_path)
+        self._log = open(self._log_path, "ab")
+        self._dirty_bytes = self._live_size_estimate()
+
+    def write_batch(self, puts: list[tuple["ColumnFamily", bytes, bytes]], deletes: list[tuple["ColumnFamily", bytes]] = ()) -> None:
+        """Atomic multi-CF write (reference: rocksdb WriteBatch used by
+        CertificateStore.write, storage/src/certificate_store.rs:55-120)."""
+        ops = []
+        for cf, key, value in puts:
+            cf._data[key] = value
+            ops.append((0, cf.name, key, value))
+        for cf, key in deletes:
+            cf._data.pop(key, None)
+            ops.append((1, cf.name, key, b""))
+        self._append(ops)
+        for cf, key, value in puts:
+            cf._notify(key, value)
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+
+class ColumnFamily:
+    """Generic byte KV map with notify_read
+    (typed-store Store<K,V> analog)."""
+
+    def __init__(self, name: str, engine: StorageEngine):
+        self.name = name
+        self._engine = engine
+        self._data: dict[bytes, bytes] = {}
+        self._waiters: dict[bytes, list[asyncio.Future]] = {}
+
+    # -- sync ops ---------------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> None:
+        self._engine.write_batch([(self, key, value)])
+
+    def put_all(self, items: Iterable[tuple[bytes, bytes]]) -> None:
+        self._engine.write_batch([(self, k, v) for k, v in items])
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def get_all(self, keys: Iterable[bytes]) -> list[bytes | None]:
+        return [self._data.get(k) for k in keys]
+
+    def contains(self, key: bytes) -> bool:
+        return key in self._data
+
+    def delete(self, key: bytes) -> None:
+        self._engine.write_batch([], [(self, key)])
+
+    def delete_all(self, keys: Iterable[bytes]) -> None:
+        self._engine.write_batch([], [(self, k) for k in keys])
+
+    def iter(self) -> Iterator[tuple[bytes, bytes]]:
+        return iter(list(self._data.items()))
+
+    def keys(self) -> list[bytes]:
+        return list(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    # -- notify_read ------------------------------------------------------
+    async def notify_read(self, key: bytes) -> bytes:
+        """Return the value, blocking until someone writes it
+        (storage/src/certificate_store.rs:138-160). Cancellation-safe: a
+        cancelled waiter is pruned on the next notify."""
+        val = self._data.get(key)
+        if val is not None:
+            return val
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(key, []).append(fut)
+        try:
+            return await fut
+        finally:
+            lst = self._waiters.get(key)
+            if lst is not None:
+                try:
+                    lst.remove(fut)
+                except ValueError:
+                    pass
+                if not lst:
+                    self._waiters.pop(key, None)
+
+    def _notify(self, key: bytes, value: bytes) -> None:
+        for fut in self._waiters.pop(key, []):
+            if not fut.done():
+                fut.set_result(value)

@@ -1,0 +1,260 @@
+"""Typed stores over the storage engine — the 9 column families.
+
+Reference: NodeStorage opens 9 RocksDB CFs (/root/reference/node/src/lib.rs:53-123):
+votes, headers, certificates, certificate_id_by_round, payload, batches,
+last_committed, sequence, temp_batches. CertificateStore adds a round
+secondary index and notify_read (/root/reference/storage/src/certificate_store.rs:28-331).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable
+
+from .codec import Reader, Writer
+from .storage import ColumnFamily, StorageEngine
+from .types import (
+    Certificate,
+    Digest,
+    Header,
+    PublicKey,
+    Round,
+    SequenceNumber,
+    Vote,
+    WorkerId,
+)
+
+_RK = struct.Struct(">Q")  # big-endian round for ordered iteration
+
+
+class CertificateStore:
+    """Certificates by digest + (round, digest) secondary index + notify_read
+    (/root/reference/storage/src/certificate_store.rs)."""
+
+    def __init__(self, engine: StorageEngine):
+        self._main: ColumnFamily = engine.column_family("certificates")
+        self._by_round: ColumnFamily = engine.column_family("certificate_id_by_round")
+        self._engine = engine
+
+    @staticmethod
+    def _round_key(round: Round, origin: PublicKey, digest: Digest) -> bytes:
+        return _RK.pack(round) + origin + digest
+
+    def write(self, cert: Certificate) -> None:
+        """Atomic main+index write (certificate_store.rs:55-90)."""
+        self._engine.write_batch(
+            [
+                (self._main, cert.digest, cert.to_bytes()),
+                (self._by_round, self._round_key(cert.round, cert.origin, cert.digest), b"\0"),
+            ]
+        )
+
+    def write_all(self, certs: Iterable[Certificate]) -> None:
+        puts = []
+        for c in certs:
+            puts.append((self._main, c.digest, c.to_bytes()))
+            puts.append((self._by_round, self._round_key(c.round, c.origin, c.digest), b"\0"))
+        self._engine.write_batch(puts)
+
+    def read(self, digest: Digest) -> Certificate | None:
+        raw = self._main.get(digest)
+        return Certificate.from_bytes(raw) if raw is not None else None
+
+    def read_all(self, digests: Iterable[Digest]) -> list[Certificate | None]:
+        return [self.read(d) for d in digests]
+
+    def contains(self, digest: Digest) -> bool:
+        return self._main.contains(digest)
+
+    async def notify_read(self, digest: Digest) -> Certificate:
+        raw = await self._main.notify_read(digest)
+        return Certificate.from_bytes(raw)
+
+    def delete(self, digest: Digest) -> None:
+        cert = self.read(digest)
+        if cert is None:
+            return
+        self._engine.write_batch(
+            [],
+            [
+                (self._main, digest),
+                (self._by_round, self._round_key(cert.round, cert.origin, digest)),
+            ],
+        )
+
+    def delete_all(self, digests: Iterable[Digest]) -> None:
+        for d in digests:
+            self.delete(d)
+
+    def after_round(self, round: Round) -> list[Certificate]:
+        """All certificates with round >= round, ascending
+        (certificate_store.rs:216-242) — consensus crash recovery reads this."""
+        out = []
+        for key, _ in sorted(self._by_round.iter()):
+            (r,) = _RK.unpack(key[:8])
+            if r >= round:
+                digest = key[8 + 32 :]
+                cert = self.read(digest)
+                if cert is not None:
+                    out.append(cert)
+        return out
+
+    def last_round(self, origin: PublicKey | None = None) -> Round:
+        """Highest round (optionally of one origin) with a stored certificate
+        (certificate_store.rs:244-331); 0 when empty."""
+        best = 0
+        for key, _ in self._by_round.iter():
+            (r,) = _RK.unpack(key[:8])
+            if origin is not None and key[8 : 8 + 32] != origin:
+                continue
+            best = max(best, r)
+        return best
+
+    def __len__(self) -> int:
+        return len(self._main)
+
+
+class HeaderStore:
+    def __init__(self, engine: StorageEngine):
+        self._cf = engine.column_family("headers")
+
+    def write(self, header: Header) -> None:
+        self._cf.put(header.digest, header.to_bytes())
+
+    def read(self, digest: Digest) -> Header | None:
+        raw = self._cf.get(digest)
+        return Header.from_bytes(raw) if raw is not None else None
+
+    async def notify_read(self, digest: Digest) -> Header:
+        return Header.from_bytes(await self._cf.notify_read(digest))
+
+    def delete_all(self, digests: Iterable[Digest]) -> None:
+        self._cf.delete_all(digests)
+
+
+class PayloadStore:
+    """(BatchDigest, WorkerId) -> available token
+    (node/src/lib.rs payload_store)."""
+
+    def __init__(self, engine: StorageEngine):
+        self._cf = engine.column_family("payload")
+
+    @staticmethod
+    def _key(digest: Digest, worker_id: WorkerId) -> bytes:
+        return digest + struct.pack("<I", worker_id)
+
+    def write(self, digest: Digest, worker_id: WorkerId) -> None:
+        self._cf.put(self._key(digest, worker_id), b"\1")
+
+    def contains(self, digest: Digest, worker_id: WorkerId) -> bool:
+        return self._cf.contains(self._key(digest, worker_id))
+
+    async def notify_contains(self, digest: Digest, worker_id: WorkerId) -> None:
+        await self._cf.notify_read(self._key(digest, worker_id))
+
+    def delete_all(self, pairs: Iterable[tuple[Digest, WorkerId]]) -> None:
+        self._cf.delete_all(self._key(d, w) for d, w in pairs)
+
+
+class BatchStore:
+    """BatchDigest -> serialized batch bytes (the worker's bulk store)."""
+
+    def __init__(self, engine: StorageEngine, name: str = "batches"):
+        self._cf = engine.column_family(name)
+
+    def write(self, digest: Digest, serialized: bytes) -> None:
+        self._cf.put(digest, serialized)
+
+    def read(self, digest: Digest) -> bytes | None:
+        return self._cf.get(digest)
+
+    async def notify_read(self, digest: Digest) -> bytes:
+        return await self._cf.notify_read(digest)
+
+    def contains(self, digest: Digest) -> bool:
+        return self._cf.contains(digest)
+
+    def delete_all(self, digests: Iterable[Digest]) -> None:
+        self._cf.delete_all(digests)
+
+    def __len__(self) -> int:
+        return len(self._cf)
+
+
+class VoteDigestStore:
+    """origin -> last vote info (round, header_digest) — the equivocation
+    guard that must survive restart (primary/src/core.rs:281-308)."""
+
+    def __init__(self, engine: StorageEngine):
+        self._cf = engine.column_family("votes")
+
+    def write(self, origin: PublicKey, round: Round, header_digest: Digest) -> None:
+        self._cf.put(origin, struct.pack("<Q", round) + header_digest)
+
+    def read(self, origin: PublicKey) -> tuple[Round, Digest] | None:
+        raw = self._cf.get(origin)
+        if raw is None:
+            return None
+        (r,) = struct.unpack("<Q", raw[:8])
+        return r, raw[8:]
+
+
+class ConsensusStore:
+    """last_committed per authority + global sequence
+    (/root/reference/types/src/consensus.rs:24-95)."""
+
+    def __init__(self, engine: StorageEngine):
+        self._last = engine.column_family("last_committed")
+        self._seq = engine.column_family("sequence")
+        self._engine = engine
+
+    def write_consensus_state(
+        self,
+        last_committed: dict[PublicKey, Round],
+        consensus_index: SequenceNumber,
+        cert_digest: Digest,
+    ) -> None:
+        """Atomic per-commit persistence (types/src/consensus.rs:50-65)."""
+        puts = [
+            (self._last, pk, struct.pack("<Q", r)) for pk, r in last_committed.items()
+        ]
+        puts.append((self._seq, _RK.pack(consensus_index), cert_digest))
+        self._engine.write_batch(puts)
+
+    def read_last_committed(self) -> dict[PublicKey, Round]:
+        return {
+            pk: struct.unpack("<Q", raw)[0] for pk, raw in self._last.iter()
+        }
+
+    def last_consensus_index(self) -> SequenceNumber:
+        idx = -1
+        for key, _ in self._seq.iter():
+            (i,) = _RK.unpack(key)
+            idx = max(idx, i)
+        return idx + 1
+
+    def read_sequenced_digests_after(self, index: SequenceNumber) -> list[tuple[SequenceNumber, Digest]]:
+        out = []
+        for key, val in sorted(self._seq.iter()):
+            (i,) = _RK.unpack(key)
+            if i >= index:
+                out.append((i, val))
+        return out
+
+
+class NodeStorage:
+    """All stores of one node, the NodeStorage::reopen analog
+    (/root/reference/node/src/lib.rs:43-124)."""
+
+    def __init__(self, path: str | None):
+        self.engine = StorageEngine(path)
+        self.vote_digest_store = VoteDigestStore(self.engine)
+        self.header_store = HeaderStore(self.engine)
+        self.certificate_store = CertificateStore(self.engine)
+        self.payload_store = PayloadStore(self.engine)
+        self.batch_store = BatchStore(self.engine, "batches")
+        self.temp_batch_store = BatchStore(self.engine, "temp_batches")
+        self.consensus_store = ConsensusStore(self.engine)
+
+    def close(self) -> None:
+        self.engine.close()

@@ -1,0 +1,191 @@
+"""RPC mesh tests — real loopback sockets like the reference's mock servers
+(/root/reference/test_utils/src/lib.rs:176-359)."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.channels import Channel
+from narwhal_tpu.messages import (
+    Ack,
+    CertificateMsg,
+    SubmitTransactionMsg,
+    WorkerBatchMsg,
+    WorkerBatchRequest,
+    WorkerBatchResponse,
+)
+from narwhal_tpu.network import NetworkClient, RetryConfig, RpcError, RpcServer
+from narwhal_tpu.fixtures import CommitteeFixture
+from narwhal_tpu.types import Batch
+
+
+def test_request_response(run):
+    async def scenario():
+        server = RpcServer()
+        received = Channel(100)
+
+        async def on_batch(msg, peer):
+            await received.send(msg)
+            return None  # ack
+
+        async def on_batch_request(msg: WorkerBatchRequest, peer):
+            return WorkerBatchResponse((b"batch-bytes",))
+
+        server.route(WorkerBatchMsg, on_batch)
+        server.route(WorkerBatchRequest, on_batch_request)
+        port = await server.start("127.0.0.1", 0)
+
+        net = NetworkClient()
+        addr = f"127.0.0.1:{port}"
+        batch = Batch((b"tx",))
+
+        # oneway + ack
+        ok = await net.unreliable_send(addr, WorkerBatchMsg(batch.to_bytes()))
+        assert ok
+        got = await asyncio.wait_for(received.recv(), 1.0)
+        assert got.batch() == batch
+
+        # typed rpc
+        resp = await net.request(addr, WorkerBatchRequest((batch.digest,)))
+        assert isinstance(resp, WorkerBatchResponse)
+        assert resp.batches == (b"batch-bytes",)
+
+        net.close()
+        await server.stop()
+
+    run(scenario())
+
+
+def test_unreliable_send_to_dead_peer(run):
+    async def scenario():
+        net = NetworkClient()
+        ok = await net.unreliable_send("127.0.0.1:1", SubmitTransactionMsg(b"x"), timeout=1.0)
+        assert not ok
+        net.close()
+
+    run(scenario())
+
+
+def test_reliable_send_retries_until_server_appears(run):
+    async def scenario():
+        from narwhal_tpu.config import get_available_port
+
+        port = get_available_port()
+        addr = f"127.0.0.1:{port}"
+        net = NetworkClient(RetryConfig(initial=0.02, max_elapsed=None))
+        received = Channel(10)
+
+        handle = net.send(addr, SubmitTransactionMsg(b"hello"))
+        await asyncio.sleep(0.1)  # several failed attempts
+
+        server = RpcServer()
+
+        async def on_tx(msg, peer):
+            await received.send(msg)
+            return None
+
+        server.route(SubmitTransactionMsg, on_tx)
+        await server.start("127.0.0.1", port)
+
+        assert await asyncio.wait_for(handle, 5.0) is True
+        got = await asyncio.wait_for(received.recv(), 1.0)
+        assert got.transaction == b"hello"
+        net.close()
+        await server.stop()
+
+    run(scenario())
+
+
+def test_reliable_send_cancel(run):
+    async def scenario():
+        net = NetworkClient(RetryConfig(initial=0.02, max_elapsed=None))
+        handle = net.send("127.0.0.1:1", SubmitTransactionMsg(b"x"))
+        await asyncio.sleep(0.05)
+        handle.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await handle
+        net.close()
+
+    run(scenario())
+
+
+def test_handler_error_becomes_rpc_error(run):
+    async def scenario():
+        server = RpcServer()
+
+        async def boom(msg, peer):
+            raise ValueError("kaboom")
+
+        server.route(SubmitTransactionMsg, boom)
+        port = await server.start("127.0.0.1", 0)
+        net = NetworkClient()
+        with pytest.raises(RpcError, match="kaboom"):
+            await net.request(f"127.0.0.1:{port}", SubmitTransactionMsg(b"x"))
+        # connection survives an error response
+        with pytest.raises(RpcError):
+            await net.request(f"127.0.0.1:{port}", SubmitTransactionMsg(b"y"))
+        net.close()
+        await server.stop()
+
+    run(scenario())
+
+
+def test_broadcast_and_lucky(run):
+    async def scenario():
+        servers, addrs, chans = [], [], []
+        for _ in range(4):
+            s = RpcServer()
+            ch = Channel(10)
+
+            async def make(ch_):
+                async def on(msg, peer):
+                    await ch_.send(msg)
+
+                return on
+
+            s.route(CertificateMsg, await make(ch))
+            port = await s.start("127.0.0.1", 0)
+            servers.append(s)
+            addrs.append(f"127.0.0.1:{port}")
+            chans.append(ch)
+
+        f = CommitteeFixture(size=4)
+        cert = f.certificate(f.header(author=0, round=1))
+        net = NetworkClient()
+
+        handles = net.broadcast(addrs, CertificateMsg(cert))
+        results = await asyncio.gather(*handles)
+        assert results == [True] * 4
+        for ch in chans:
+            got = await asyncio.wait_for(ch.recv(), 1.0)
+            assert got.certificate == cert
+
+        oks = await net.lucky_broadcast(addrs, CertificateMsg(cert), nodes=2)
+        assert sum(oks) == 2
+
+        net.close()
+        for s in servers:
+            await s.stop()
+
+    run(scenario())
+
+
+def test_large_frame(run):
+    async def scenario():
+        server = RpcServer()
+
+        async def echo(msg: WorkerBatchMsg, peer):
+            return WorkerBatchResponse((msg.serialized_batch,))
+
+        server.route(WorkerBatchMsg, echo)
+        port = await server.start("127.0.0.1", 0)
+        net = NetworkClient()
+        big = Batch(tuple(bytes([i % 256]) * 512 for i in range(2000)))  # ~1MB
+        resp = await net.request(
+            f"127.0.0.1:{port}", WorkerBatchMsg(big.to_bytes()), timeout=10.0
+        )
+        assert resp.batches[0] == big.to_bytes()
+        net.close()
+        await server.stop()
+
+    run(scenario())
