@@ -1,0 +1,6 @@
+from .state import ConsensusState
+from .bullshark import Bullshark
+from .tusk import Tusk
+from .runner import Consensus
+
+__all__ = ["ConsensusState", "Bullshark", "Tusk", "Consensus"]

@@ -1,0 +1,112 @@
+"""The Consensus actor: feeds certificates to the ordering engine.
+
+Reference: /root/reference/consensus/src/consensus.rs:175-361 — recover state
+from the consensus/certificate stores, then loop: pull certificates from the
+primary, run the protocol, forward ordered outputs to the executor
+(tx_output) and committed certificates back to the primary (tx_primary, which
+drives StateHandler GC), logging the benchmark-parsed "Committed ..." lines.
+Epoch changes observed on the reconfigure watch reset the state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel, Subscriber, Watch
+from ..config import Committee
+from ..stores import CertificateStore, ConsensusStore
+from ..types import Certificate, ConsensusOutput, ReconfigureNotification, Round
+from .state import ConsensusState
+
+logger = logging.getLogger("narwhal.consensus")
+
+
+class Consensus:
+    def __init__(
+        self,
+        committee: Committee,
+        protocol,
+        consensus_store: ConsensusStore,
+        cert_store: CertificateStore,
+        rx_new_certificates: Channel,
+        tx_primary: Channel,
+        tx_output: Channel,
+        rx_reconfigure: Watch,
+        gc_depth: Round,
+        metrics=None,
+    ):
+        self.committee = committee
+        self.protocol = protocol
+        self.consensus_store = consensus_store
+        self.cert_store = cert_store
+        self.rx_new_certificates = rx_new_certificates
+        self.tx_primary = tx_primary
+        self.tx_output = tx_output
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+        self.gc_depth = gc_depth
+        self.metrics = metrics
+        self.consensus_index = consensus_store.last_consensus_index()
+        self.state = ConsensusState.new_from_store(
+            Certificate.genesis(committee),
+            consensus_store.read_last_committed(),
+            cert_store,
+            gc_depth,
+            metrics,
+        )
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self.run())
+        return self._task
+
+    async def run(self) -> None:
+        recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
+        cert_task = asyncio.ensure_future(self.rx_new_certificates.recv())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    {recon_task, cert_task}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if recon_task in done:
+                    note: ReconfigureNotification = recon_task.result()
+                    if note.kind == "shutdown":
+                        return
+                    if note.committee is not None:
+                        self.committee = note.committee
+                        self.protocol.update_committee(note.committee)
+                        self.state = ConsensusState(
+                            Certificate.genesis(note.committee), self.metrics
+                        )
+                        self.consensus_index = 0
+                        logger.info("Committee updated to epoch %s", note.committee.epoch)
+                    recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
+                if cert_task in done:
+                    certificate: Certificate = cert_task.result()
+                    cert_task = asyncio.ensure_future(self.rx_new_certificates.recv())
+                    if certificate.epoch != self.committee.epoch:
+                        continue  # stale epoch, drop
+                    await self._process(certificate)
+        finally:
+            recon_task.cancel()
+            cert_task.cancel()
+
+    async def _process(self, certificate: Certificate) -> None:
+        sequence = self.protocol.process_certificate(
+            self.state, self.consensus_index, certificate
+        )
+        if sequence:
+            self.consensus_index = sequence[-1].consensus_index + 1
+        for output in sequence:
+            cert = output.certificate
+            if cert.round % 10 == 0:
+                logger.debug("Committed %s round %s", cert.digest.hex()[:16], cert.round)
+            # The benchmark-parsed commit line (consensus.rs:312-316).
+            logger.info("Committed B%s(%s)", cert.round, cert.digest.hex())
+            if self.metrics is not None:
+                self.metrics.last_committed_round.set(self.state.last_committed_round)
+                self.metrics.committed_certificates.inc()
+            await self.tx_primary.send(cert)
+            await self.tx_output.send(output)
+        if self.metrics is not None:
+            self.metrics.consensus_dag_size.set(self.state.dag_size())

@@ -1,0 +1,87 @@
+"""In-memory consensus DAG state with crash recovery and GC.
+
+Reference: /root/reference/consensus/src/consensus.rs:24-157 (ConsensusState,
+new_from_store, construct_dag_from_cert_store, update). The DAG is
+round -> {authority -> (digest, certificate)}; `last_committed` per authority
+both deduplicates commits and drives GC.
+"""
+
+from __future__ import annotations
+
+from ..stores import CertificateStore
+from ..types import Certificate, Digest, PublicKey, Round
+
+DagMap = dict[Round, dict[PublicKey, tuple[Digest, Certificate]]]
+
+
+class ConsensusState:
+    def __init__(self, genesis: list[Certificate], metrics=None):
+        gen = {c.origin: (c.digest, c) for c in genesis}
+        self.last_committed_round: Round = 0
+        self.last_committed: dict[PublicKey, Round] = {
+            pk: cert.round for pk, (_, cert) in gen.items()
+        }
+        self.dag: DagMap = {0: gen}
+        self.metrics = metrics
+
+    @staticmethod
+    def new_from_store(
+        genesis: list[Certificate],
+        recover_last_committed: dict[PublicKey, Round],
+        cert_store: CertificateStore,
+        gc_depth: Round,
+        metrics=None,
+    ) -> "ConsensusState":
+        """Rebuild the DAG window from the certificate store after a crash
+        (consensus.rs:63-129)."""
+        state = ConsensusState(genesis, metrics)
+        if not recover_last_committed:
+            return state
+        last_committed_round = max(recover_last_committed.values())
+        if last_committed_round == 0:
+            return state
+        state.last_committed_round = last_committed_round
+        state.last_committed = dict(recover_last_committed)
+        min_round = max(0, last_committed_round - gc_depth)
+        dag: DagMap = {}
+        for cert in cert_store.after_round(min_round + 1):
+            # Mirror the shape update() leaves behind in a live state: each
+            # authority keeps its certificate at exactly its last committed
+            # round, nothing older (consensus.rs:145-156). Without this, a
+            # recovered window would re-expose already-committed certificates
+            # to the ordering walk.
+            if cert.round < recover_last_committed.get(cert.origin, 0):
+                continue
+            dag.setdefault(cert.round, {})[cert.origin] = (cert.digest, cert)
+        state.dag = dag
+        if metrics is not None:
+            metrics.recovered_consensus_state.inc()
+        return state
+
+    def add(self, certificate: Certificate) -> None:
+        self.dag.setdefault(certificate.round, {})[certificate.origin] = (
+            certificate.digest,
+            certificate,
+        )
+
+    def update(self, certificate: Certificate, gc_depth: Round) -> None:
+        """Advance last_committed and GC the window (consensus.rs:131-157)."""
+        origin = certificate.origin
+        self.last_committed[origin] = max(
+            self.last_committed.get(origin, 0), certificate.round
+        )
+        self.last_committed_round = max(self.last_committed.values())
+
+        # Purge rounds beyond the GC window.
+        for r in [r for r in self.dag if r + gc_depth < self.last_committed_round]:
+            del self.dag[r]
+        # Purge each authority's certificates before its own last commit.
+        for name, committed_round in self.last_committed.items():
+            for r in list(self.dag):
+                if r < committed_round:
+                    self.dag[r].pop(name, None)
+                    if not self.dag[r]:
+                        del self.dag[r]
+
+    def dag_size(self) -> int:
+        return sum(len(v) for v in self.dag.values())

@@ -1,0 +1,296 @@
+"""Vectorized DAG kernels: the consensus commit walk as adjacency tensors.
+
+Reference hot loop: /root/reference/consensus/src/utils.rs:11-101 — per-commit
+pointer-chasing DFS (order_dag), frontier filtering (linked) and per-round
+leader support counting — all O(window x committee) sequential work on CPU.
+
+TPU-first redesign (SURVEY §5.8, §7.8b): the DAG window is dense tensors
+  present[W, N]   uint8 — certificate exists at (round offset, authority)
+  parent [W, N, N] uint8 — parent[w, a, p] = cert (w, a) links (w-1, p)
+  stakes [N]      int32
+with W = round-window size (>= gc_depth + slack) and N = committee size.
+Reachability from any certificate is a backward scan of N x N bitwise matmuls
+(MXU/VPU work, no pointer chasing); leader support is one masked dot product.
+Commit traversal must not pass *through* already-committed certificates
+(the DFS skip in utils.rs:86-89), so propagation masks them out via
+last_committed[N].
+
+All kernels are jit-compiled with static shapes; round offsets and indices
+are traced scalars so one compilation serves every call. `TpuBullshark`
+wraps them behind the exact ConsensusProtocol interface and is
+equivalence-tested against the host engine on random lossy DAGs
+(tests/test_dag_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import Committee
+from ..stores import ConsensusStore
+from ..types import Certificate, ConsensusOutput, Digest, Round, SequenceNumber
+from ..consensus.state import ConsensusState
+
+
+@jax.jit
+def reach_mask(parent, uncommitted, start_off, start_onehot):
+    """Reachability mask [W, N]: certificates reachable from the start
+    certificate by walking parent links down the window, propagating only
+    through uncommitted certificates (the vectorized order_dag/linked core).
+
+    parent: uint8 [W, N, N]; uncommitted: uint8 [W, N] (present & not yet
+    committed); start_off: int32 round offset; start_onehot: uint8 [N].
+    """
+    W, N, _ = parent.shape
+
+    def step(frontier_above, w):
+        # frontier_above = mask row already computed for offset w+1
+        links = jnp.take(parent, jnp.minimum(w + 1, W - 1), axis=0)  # [N, N]
+        from_above = (links.astype(jnp.int32).T @ frontier_above.astype(jnp.int32)) > 0
+        here = jnp.where(
+            w == start_off,
+            start_onehot.astype(bool),
+            jnp.where(w < start_off, from_above, False),
+        )
+        here = here & uncommitted[w].astype(bool)
+        # Certificates below the start that are committed must not relay the
+        # frontier; `here` is already masked by uncommitted, and the start
+        # row is the leader itself (always explored, like the DFS root).
+        return here.astype(jnp.int32), here
+
+    ws = jnp.arange(W - 1, -1, -1)
+    _, rows = lax.scan(step, jnp.zeros((N,), jnp.int32), ws)
+    return rows[::-1]  # [W, N] bool, row w = offset w
+
+
+@jax.jit
+def leader_support(parent, present, stakes, support_off, leader_idx):
+    """Stake carried by certificates at `support_off` linking to the leader at
+    the round below (bullshark.rs:66-76 / tusk.rs:66-74)."""
+    links = jnp.take(parent, support_off, axis=0)[:, leader_idx]  # [N]
+    voters = links.astype(bool) & jnp.take(present, support_off, axis=0).astype(bool)
+    return jnp.sum(jnp.where(voters, stakes, 0))
+
+
+class DagWindow:
+    """Host-managed ring of the last W rounds as dense arrays, with the
+    digest <-> (round, authority) maps the tensors can't hold. This is the
+    'long context' of the system: rounds are the sequence axis, the committee
+    the width (SURVEY §5.8)."""
+
+    def __init__(self, committee: Committee, window: int = 64):
+        self.committee = committee
+        self.N = committee.size()
+        self.W = window
+        self.round_base: Round = 0
+        self.present = np.zeros((self.W, self.N), np.uint8)
+        self.parent = np.zeros((self.W, self.N, self.N), np.uint8)
+        self.stakes = np.asarray(committee.stakes_array(), np.int32)
+        self.certs: dict[tuple[Round, int], Certificate] = {}
+        self.digest_pos: dict[Digest, tuple[Round, int]] = {}
+        # Genesis certificates occupy round 0.
+        for cert in Certificate.genesis(committee):
+            self._place(cert)
+
+    def _off(self, round: Round) -> int:
+        return round - self.round_base
+
+    def _place(self, cert: Certificate) -> None:
+        idx = self.committee.index_of(cert.origin)
+        off = self._off(cert.round)
+        self.present[off, idx] = 1
+        self.certs[(cert.round, idx)] = cert
+        self.digest_pos[cert.digest] = (cert.round, idx)
+        for pd in cert.header.parents:
+            pos = self.digest_pos.get(pd)
+            if pos is not None and pos[0] == cert.round - 1:
+                self.parent[off, idx, pos[1]] = 1
+
+    def insert(self, cert: Certificate, keep_floor: Round) -> bool:
+        """Add a certificate; slides the window forward (dropping only rounds
+        below keep_floor, the GC bound) or grows it when commits lag behind
+        round production. Returns False only for certificates below the
+        already-GC'd base."""
+        if cert.round < self.round_base:
+            return False
+        while cert.round - self.round_base >= self.W:
+            target = cert.round - self.W + 1
+            if target <= keep_floor:
+                self.slide_to(target)
+            elif keep_floor > self.round_base:
+                self.slide_to(keep_floor)
+                self._grow()
+            else:
+                self._grow()
+        self._place(cert)
+        return True
+
+    def _grow(self) -> None:
+        """Double W (recompiles the jitted kernels for the new static shape —
+        rare, only when the uncommitted span outgrows the window)."""
+        new_w = self.W * 2
+        present = np.zeros((new_w, self.N), np.uint8)
+        parent = np.zeros((new_w, self.N, self.N), np.uint8)
+        present[: self.W] = self.present
+        parent[: self.W] = self.parent
+        self.present, self.parent, self.W = present, parent, new_w
+
+    def slide_to(self, new_base: Round) -> None:
+        shift = new_base - self.round_base
+        if shift <= 0:
+            return
+        if shift >= self.W:
+            self.present[:] = 0
+            self.parent[:] = 0
+        else:
+            self.present[:-shift] = self.present[shift:]
+            self.present[-shift:] = 0
+            self.parent[:-shift] = self.parent[shift:]
+            self.parent[-shift:] = 0
+        dropped = [(r, i) for (r, i) in self.certs if r < new_base]
+        for key in dropped:
+            cert = self.certs.pop(key)
+            self.digest_pos.pop(cert.digest, None)
+        self.round_base = new_base
+
+    def cert_at(self, round: Round, idx: int) -> Certificate | None:
+        return self.certs.get((round, idx))
+
+
+class TpuBullshark:
+    """Bullshark with the DAG walks on device. Drop-in for
+    consensus.Bullshark (same process_certificate signature/semantics,
+    equivalence-tested); the host retains only bookkeeping and the final
+    index->certificate gather."""
+
+    def __init__(
+        self,
+        committee: Committee,
+        store: ConsensusStore | None,
+        gc_depth: Round,
+        leader_fn=None,
+        window: int | None = None,
+    ):
+        self.committee = committee
+        self.store = store
+        self.gc_depth = gc_depth
+        self._leader_fn = leader_fn
+        self.win = DagWindow(committee, window or (gc_depth + 14))
+
+    # -- leader election --------------------------------------------------
+    def _leader_index(self, round: Round, dag) -> int | None:
+        if self._leader_fn is not None:
+            entry = self._leader_fn(self.committee, round, dag)
+            if entry is None:
+                return None
+            return self.committee.index_of(entry[1].origin)
+        name = self.committee.leader(round)
+        idx = self.committee.index_of(name)
+        off = self.win._off(round)
+        if 0 <= off < self.win.W and self.win.present[off, idx]:
+            return idx
+        return None
+
+    # -- tensor helpers ---------------------------------------------------
+    def _uncommitted(self, state: ConsensusState) -> np.ndarray:
+        lc = np.zeros((self.win.N,), np.int64)
+        for pk, r in state.last_committed.items():
+            lc[self.committee.index_of(pk)] = r
+        rounds = self.win.round_base + np.arange(self.win.W)[:, None]
+        return (self.win.present.astype(bool) & (rounds > lc[None, :])).astype(np.uint8)
+
+    def _reach(self, state: ConsensusState, round: Round, idx: int) -> np.ndarray:
+        onehot = np.zeros((self.win.N,), np.uint8)
+        onehot[idx] = 1
+        mask = reach_mask(
+            jnp.asarray(self.win.parent),
+            jnp.asarray(self._uncommitted(state)),
+            jnp.int32(self.win._off(round)),
+            jnp.asarray(onehot),
+        )
+        return np.asarray(mask)
+
+    # -- protocol ---------------------------------------------------------
+    def process_certificate(
+        self,
+        state: ConsensusState,
+        consensus_index: SequenceNumber,
+        certificate: Certificate,
+    ) -> list[ConsensusOutput]:
+        round = certificate.round
+        state.add(certificate)  # host mirror for recovery parity
+        keep_floor = max(0, state.last_committed_round - self.gc_depth)
+        if not self.win.insert(certificate, keep_floor):
+            raise RuntimeError(
+                f"round {round} outside DAG window (base {self.win.round_base}, W {self.win.W})"
+            )
+
+        r = round - 1
+        if r % 2 != 0 or r < 2:
+            return []
+        if r <= state.last_committed_round:
+            return []
+        leader_idx = self._leader_index(r, state.dag)
+        if leader_idx is None:
+            return []
+
+        support = int(
+            leader_support(
+                jnp.asarray(self.win.parent),
+                jnp.asarray(self.win.present),
+                jnp.asarray(self.win.stakes),
+                jnp.int32(self.win._off(round)),
+                jnp.int32(leader_idx),
+            )
+        )
+        if support < self.committee.validity_threshold():
+            return []
+
+        # Chain of linked leaders, newest to oldest (order_leaders).
+        chain: list[tuple[Round, int]] = [(r, leader_idx)]
+        cur_round, cur_idx = r, leader_idx
+        cur_reach = self._reach(state, cur_round, cur_idx)
+        for lr in range(r - 2, state.last_committed_round + 1, -2):
+            prev_idx = self._leader_index(lr, state.dag)
+            if prev_idx is None:
+                continue
+            off = self.win._off(lr)
+            if 0 <= off < self.win.W and cur_reach[off, prev_idx]:
+                chain.append((lr, prev_idx))
+                cur_round, cur_idx = lr, prev_idx
+                cur_reach = self._reach(state, cur_round, cur_idx)
+
+        sequence: list[ConsensusOutput] = []
+        for lr, lidx in reversed(chain):
+            mask = self._reach(state, lr, lidx)
+            # GC retain bound is evaluated at flatten time, before this
+            # leader's own updates advance last_committed_round (the host
+            # order_dag computes its filtered list up front).
+            lcr_at_flatten = state.last_committed_round
+            order = np.argwhere(mask)  # row-major: ascending (offset, authority)
+            for off, aidx in order:
+                cround = self.win.round_base + int(off)
+                if cround + self.gc_depth < lcr_at_flatten:
+                    continue
+                cert = self.win.cert_at(cround, int(aidx))
+                if cert is None:
+                    continue
+                state.update(cert, self.gc_depth)
+                sequence.append(
+                    ConsensusOutput(certificate=cert, consensus_index=consensus_index)
+                )
+                consensus_index += 1
+                if self.store is not None:
+                    self.store.write_consensus_state(
+                        state.last_committed, consensus_index - 1, cert.digest
+                    )
+        return sequence
+
+    def update_committee(self, new_committee: Committee) -> None:
+        self.committee = new_committee
+        self.win = DagWindow(new_committee, self.win.W)

@@ -1,0 +1,184 @@
+"""Consensus engine tests, mirroring
+/root/reference/consensus/src/tests/{bullshark_tests,tusk_tests}.rs: commit
+counts on optimal DAGs, round ordering, lossy DAGs, crash recovery."""
+
+import random
+
+from narwhal_tpu.consensus import Bullshark, ConsensusState, Tusk
+from narwhal_tpu.fixtures import CommitteeFixture, make_certificates, make_optimal_certificates
+from narwhal_tpu.stores import NodeStorage
+from narwhal_tpu.types import Certificate
+
+GC_DEPTH = 50
+
+
+def fixed_leader(committee, round, dag):
+    """The reference pins the leader to the first authority in tests
+    (bullshark.rs:150-156) so DAG shapes are predictable."""
+    return dag.get(round, {}).get(committee.authority_keys()[0])
+
+
+def _setup(size=4):
+    f = CommitteeFixture(size=size)
+    store = NodeStorage(None)
+    state = ConsensusState(Certificate.genesis(f.committee))
+    return f, store, state
+
+
+def test_bullshark_commit_one():
+    # Feed rounds 1..3: as round-3 certs arrive, leader at round 2 gets
+    # support and commits: 4 round-1 certs + the leader itself.
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_optimal_certificates(f.committee, 1, 3, genesis)
+    bull = Bullshark(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+
+    outputs = []
+    idx = 0
+    for c in certs:
+        seq = bull.process_certificate(state, idx, c)
+        idx += len(seq)
+        outputs.extend(seq)
+
+    assert len(outputs) == 5
+    assert [o.certificate.round for o in outputs] == [1, 1, 1, 1, 2]
+    assert outputs[-1].certificate.origin == f.committee.authority_keys()[0]
+    assert [o.consensus_index for o in outputs] == list(range(5))
+
+
+def test_bullshark_commit_chain():
+    # 10 rounds: leaders at rounds 2,4,6,8 commit as support arrives.
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_optimal_certificates(f.committee, 1, 10, genesis)
+    bull = Bullshark(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+
+    outputs = []
+    idx = 0
+    for c in certs:
+        seq = bull.process_certificate(state, idx, c)
+        idx += len(seq)
+        outputs.extend(seq)
+
+    committed = [o.certificate for o in outputs]
+    # no duplicates
+    assert len({c.digest for c in committed}) == len(committed)
+    # rounds are non-decreasing within each leader commit and overall history
+    # is complete below the last committed leader round (8)
+    assert state.last_committed_round == 8
+    by_round = {}
+    for c in committed:
+        by_round.setdefault(c.round, 0)
+        by_round[c.round] += 1
+    for r in range(1, 7):
+        assert by_round[r] == 4, f"round {r} fully committed"
+    # consensus indices are consecutive
+    assert [o.consensus_index for o in outputs] == list(range(len(outputs)))
+
+
+def test_bullshark_missing_leader_no_commit():
+    # Exclude the fixed leader from rounds 1..4: nothing can commit.
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    keys = f.committee.authority_keys()[1:]
+    certs, _ = make_certificates(f.committee, 1, 4, genesis, keys=keys)
+    bull = Bullshark(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+    idx = 0
+    for c in certs:
+        assert bull.process_certificate(state, idx, c) == []
+
+
+def test_bullshark_lossy_dag_still_commits():
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_certificates(
+        f.committee, 1, 20, genesis, failure_probability=0.3,
+        rng=random.Random(7),
+    )
+    bull = Bullshark(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+    outputs = []
+    idx = 0
+    for c in certs:
+        seq = bull.process_certificate(state, idx, c)
+        idx += len(seq)
+        outputs.extend(seq)
+    assert len(outputs) > 0
+    assert len({o.certificate.digest for o in outputs}) == len(outputs)
+    rounds = [o.certificate.round for o in outputs]
+    assert state.last_committed_round >= 2
+
+
+def test_tusk_commit_latency_one_extra_round():
+    # Tusk: leader at round 2 commits only once round-5 certificates arrive
+    # (r=4 even, leader_round=2, support at round 3).
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_optimal_certificates(f.committee, 1, 5, genesis)
+    tusk = Tusk(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+    outputs = []
+    idx = 0
+    per_round = {}
+    for c in certs:
+        seq = tusk.process_certificate(state, idx, c)
+        idx += len(seq)
+        outputs.extend(seq)
+        if seq:
+            per_round.setdefault(c.round, []).extend(seq)
+    assert outputs, "tusk committed nothing"
+    assert min(per_round) == 5  # first commit triggered by a round-5 cert
+    assert [o.certificate.round for o in outputs][:5] == [1, 1, 1, 1, 2]
+
+
+def test_state_crash_recovery():
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_optimal_certificates(f.committee, 1, 10, genesis)
+    bull = Bullshark(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+    store.certificate_store.write_all(certs)
+    outputs = []
+    idx = 0
+    for c in certs:
+        seq = bull.process_certificate(state, idx, c)
+        idx += len(seq)
+        outputs.extend(seq)
+    assert outputs
+
+    # "crash": rebuild state from stores; resume processing more rounds.
+    recovered = ConsensusState.new_from_store(
+        Certificate.genesis(f.committee),
+        store.consensus_store.read_last_committed(),
+        store.certificate_store,
+        GC_DEPTH,
+    )
+    assert recovered.last_committed_round == state.last_committed_round
+    assert recovered.last_committed == state.last_committed
+
+    parents = {c.digest for c in certs if c.round == 10}
+    more, _ = make_optimal_certificates(f.committee, 11, 14, parents)
+    bull2 = Bullshark(f.committee, store.consensus_store, GC_DEPTH, leader_fn=fixed_leader)
+    idx2 = store.consensus_store.last_consensus_index()
+    resumed = []
+    for c in more:
+        seq = bull2.process_certificate(recovered, idx2, c)
+        idx2 += len(seq)
+        resumed.extend(seq)
+    assert resumed, "no progress after recovery"
+    committed_digests = {o.certificate.digest for o in outputs}
+    assert all(o.certificate.digest not in committed_digests for o in resumed), (
+        "recovery must not recommit"
+    )
+
+
+def test_gc_bounds_dag():
+    f, store, state = _setup()
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    gc = 5
+    certs, _ = make_optimal_certificates(f.committee, 1, 40, genesis)
+    bull = Bullshark(f.committee, store.consensus_store, gc, leader_fn=fixed_leader)
+    idx = 0
+    for c in certs:
+        seq = bull.process_certificate(state, idx, c)
+        idx += len(seq)
+    assert state.last_committed_round == 38
+    assert min(state.dag.keys()) >= state.last_committed_round - gc
+    assert state.dag_size() <= (gc + 3) * 4

@@ -1,0 +1,129 @@
+"""TPU DAG kernel equivalence: the vectorized adjacency-tensor commit walk
+must reproduce the host engine's sequence bit-for-bit on arbitrary DAGs.
+Runs on the virtual CPU backend (conftest); bench.py exercises the same
+kernels on the real chip."""
+
+import random
+
+import numpy as np
+import pytest
+
+from narwhal_tpu.consensus import Bullshark, ConsensusState
+from narwhal_tpu.fixtures import CommitteeFixture, make_certificates, make_optimal_certificates
+from narwhal_tpu.stores import NodeStorage
+from narwhal_tpu.tpu.dag_kernels import DagWindow, TpuBullshark, leader_support, reach_mask
+from narwhal_tpu.types import Certificate
+
+from tests.test_consensus import fixed_leader
+
+GC = 50
+
+
+def _run_both(size, rounds, failure, seed, gc=GC, leader_fn=fixed_leader, window=None):
+    f = CommitteeFixture(size=size)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_certificates(
+        f.committee, 1, rounds, genesis,
+        failure_probability=failure, rng=random.Random(seed),
+    )
+    host_state = ConsensusState(Certificate.genesis(f.committee))
+    tpu_state = ConsensusState(Certificate.genesis(f.committee))
+    host = Bullshark(f.committee, NodeStorage(None).consensus_store, gc, leader_fn=leader_fn)
+    dev = TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc,
+                       leader_fn=leader_fn, window=window)
+    host_seq, dev_seq = [], []
+    hi = di = 0
+    for c in certs:
+        hs = host.process_certificate(host_state, hi, c)
+        ds = dev.process_certificate(tpu_state, di, c)
+        hi += len(hs)
+        di += len(ds)
+        host_seq.extend(hs)
+        dev_seq.extend(ds)
+        assert [o.certificate.digest for o in hs] == [o.certificate.digest for o in ds], (
+            f"diverged at round {c.round}"
+        )
+    assert host_state.last_committed == tpu_state.last_committed
+    assert [o.consensus_index for o in host_seq] == [o.consensus_index for o in dev_seq]
+    return host_seq
+
+
+def test_equivalence_optimal_dag():
+    seq = _run_both(size=4, rounds=12, failure=0.0, seed=0)
+    assert len(seq) > 30
+
+
+def test_equivalence_lossy_dags():
+    for seed in range(5):
+        _run_both(size=4, rounds=25, failure=0.3, seed=seed)
+
+
+def test_equivalence_larger_committee():
+    _run_both(size=10, rounds=15, failure=0.15, seed=3)
+
+
+def test_equivalence_weighted_leader():
+    # default (stake-weighted) leader election on both sides
+    _run_both(size=7, rounds=20, failure=0.2, seed=1, leader_fn=None)
+
+
+def test_equivalence_small_window_slides():
+    # Window smaller than the run length forces sliding + GC drops.
+    seq = _run_both(size=4, rounds=60, failure=0.0, seed=0, gc=10, window=24)
+    assert len(seq) > 200
+
+
+def test_window_grows_when_no_commits():
+    # No leader ever present => no commits => window must grow, not slide.
+    f = CommitteeFixture(size=4)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    keys = f.committee.authority_keys()[1:]
+    certs, _ = make_certificates(f.committee, 1, 40, genesis, keys=keys)
+    state = ConsensusState(Certificate.genesis(f.committee))
+    dev = TpuBullshark(f.committee, None, gc_depth=10, leader_fn=fixed_leader, window=16)
+    for c in certs:
+        assert dev.process_certificate(state, 0, c) == []
+    assert dev.win.W >= 40
+
+
+def test_reach_mask_simple_chain():
+    # Hand-built 3-round window over 2 authorities:
+    # (2,0) -> (1,1) -> (0,0); (1,0) unlinked.
+    import jax.numpy as jnp
+
+    parent = np.zeros((3, 2, 2), np.uint8)
+    present = np.ones((3, 2), np.uint8)
+    parent[2, 0, 1] = 1  # (2,0) links (1,1)
+    parent[1, 1, 0] = 1  # (1,1) links (0,0)
+    onehot = np.array([1, 0], np.uint8)
+    mask = np.asarray(
+        reach_mask(jnp.asarray(parent), jnp.asarray(present), jnp.int32(2), jnp.asarray(onehot))
+    )
+    expected = np.array([[1, 0], [0, 1], [1, 0]], bool)
+    assert (mask == expected).all()
+
+    # Committed relay blocks propagation: mark (1,1) committed.
+    unc = present.copy()
+    unc[1, 1] = 0
+    mask2 = np.asarray(
+        reach_mask(jnp.asarray(parent), jnp.asarray(unc), jnp.int32(2), jnp.asarray(onehot))
+    )
+    expected2 = np.array([[0, 0], [0, 0], [1, 0]], bool)
+    assert (mask2 == expected2).all()
+
+
+def test_leader_support_kernel():
+    import jax.numpy as jnp
+
+    parent = np.zeros((2, 3, 3), np.uint8)
+    present = np.ones((2, 3), np.uint8)
+    stakes = np.array([5, 7, 11], np.int32)
+    parent[1, 0, 2] = 1  # authority 0 at round 1 links leader (0, 2)
+    parent[1, 2, 2] = 1  # authority 2 links it too
+    got = int(
+        leader_support(
+            jnp.asarray(parent), jnp.asarray(present), jnp.asarray(stakes),
+            jnp.int32(1), jnp.int32(2),
+        )
+    )
+    assert got == 16  # 5 + 11
