@@ -181,7 +181,7 @@ class RpcServer:
         self._handlers: dict[int, Handler] = {}
         self._server: asyncio.AbstractServer | None = None
         self._max_concurrency = max_concurrency
-        self._conn_tasks: set[asyncio.Task] = set()
+        self._writers: set[asyncio.StreamWriter] = set()
 
     def route(self, msg_cls, handler: Handler) -> None:
         self._handlers[msg_cls.TAG] = handler
@@ -201,6 +201,7 @@ class RpcServer:
     ) -> None:
         peer = writer.get_extra_info("peername")
         peer_addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        self._writers.add(writer)
         sem = asyncio.Semaphore(self._max_concurrency)
         tasks: set[asyncio.Task] = set()
         try:
@@ -217,6 +218,7 @@ class RpcServer:
         except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError):
             pass
         finally:
+            self._writers.discard(writer)
             for t in tasks:
                 t.cancel()
             try:
@@ -250,6 +252,14 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Drop live connections: wait_closed() (3.12+) waits for every
+            # connection handler, which would otherwise run until the peer
+            # hangs up.
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
             await self._server.wait_closed()
 
 

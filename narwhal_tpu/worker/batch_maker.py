@@ -1,0 +1,82 @@
+"""BatchMaker: accumulate transactions into sealed batches.
+
+Reference: /root/reference/worker/src/batch_maker.rs:48-193 — seal when the
+pending bytes reach `batch_size` or `max_batch_delay` elapses; under the
+benchmark feature it logs "Batch {digest} contains sample tx {id}" for sample
+transactions (first byte 0, u64 id following) and "Batch {digest} contains
+{n} B" — the log lines the benchmark harness parses for TPS/latency
+(benchmark/benchmark/logs.py:171-244). We emit byte-compatible lines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+
+from ..channels import Channel, Subscriber, Watch
+from ..types import Batch
+
+logger = logging.getLogger("narwhal.worker")
+
+
+class BatchMaker:
+    def __init__(
+        self,
+        batch_size: int,
+        max_batch_delay: float,
+        rx_transaction: Channel,
+        tx_message: Channel,
+        rx_reconfigure: Watch,
+        metrics=None,
+        benchmark: bool = False,
+    ):
+        self.batch_size = batch_size
+        self.max_batch_delay = max_batch_delay
+        self.rx_transaction = rx_transaction
+        self.tx_message = tx_message
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+        self.metrics = metrics
+        self.benchmark = benchmark
+        self._pending: list[bytes] = []
+        self._pending_bytes = 0
+
+    def spawn(self) -> asyncio.Task:
+        return asyncio.ensure_future(self.run())
+
+    async def run(self) -> None:
+        while True:
+            try:
+                tx = await asyncio.wait_for(
+                    self.rx_transaction.recv(), timeout=self.max_batch_delay
+                )
+                if self.rx_reconfigure.peek().kind == "shutdown":
+                    return
+                self._pending.append(tx)
+                self._pending_bytes += len(tx)
+                if self._pending_bytes >= self.batch_size:
+                    await self._seal()
+            except asyncio.TimeoutError:
+                if self.rx_reconfigure.peek().kind == "shutdown":
+                    return
+                if self._pending:
+                    await self._seal()
+
+    async def _seal(self) -> None:
+        batch = Batch(tuple(self._pending))
+        size = self._pending_bytes
+        self._pending = []
+        self._pending_bytes = 0
+        if self.benchmark:
+            digest = batch.digest
+            for tx in batch.transactions:
+                # Sample txs: first byte 0, u64 counter follows (the
+                # benchmark client's marker, node/src/benchmark_client.rs).
+                if len(tx) >= 9 and tx[0] == 0:
+                    (sample_id,) = struct.unpack_from(">Q", tx, 1)
+                    logger.info("Batch %s contains sample tx %d", digest.hex(), sample_id)
+            logger.info("Batch %s contains %d B", digest.hex(), size)
+        if self.metrics is not None:
+            self.metrics.created_batch_size.observe(size)
+            self.metrics.batches_made.inc()
+        await self.tx_message.send(batch)

@@ -1,0 +1,25 @@
+"""Worker metrics (/root/reference/worker/src/metrics.rs)."""
+
+from __future__ import annotations
+
+from ..metrics import Registry
+
+
+class WorkerMetrics:
+    def __init__(self, registry: Registry):
+        self.created_batch_size = registry.histogram(
+            "worker_created_batch_size", "Size in bytes of sealed batches",
+            buckets=(1_000, 10_000, 50_000, 100_000, 250_000, 500_000, 1_000_000),
+        )
+        self.batches_made = registry.counter(
+            "worker_batches_made", "Batches sealed by the batch maker"
+        )
+        self.batches_received = registry.counter(
+            "worker_batches_received", "Batches received from peer workers"
+        )
+        self.pending_sync_batches = registry.gauge(
+            "worker_pending_sync_batches", "Batches the synchronizer is awaiting"
+        )
+        self.tx_received = registry.counter(
+            "worker_tx_received", "Transactions received from clients"
+        )

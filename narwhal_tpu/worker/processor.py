@@ -1,0 +1,51 @@
+"""Processor: hash, persist and report each batch to the primary.
+
+Reference: /root/reference/worker/src/processor.rs:22-73 — digest the
+*serialized* batch (zero-copy, types/src/worker.rs:44-62), write it to the
+batch store, and emit OurBatch (own dissemination path) or OthersBatch (peer
+receive path) to the primary connector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..channels import Channel, Subscriber, Watch
+from ..messages import OthersBatchMsg, OurBatchMsg
+from ..stores import BatchStore
+from ..types import WorkerId, serialized_batch_digest
+
+
+class Processor:
+    def __init__(
+        self,
+        worker_id: WorkerId,
+        store: BatchStore,
+        rx_batch: Channel,
+        tx_digest: Channel,
+        rx_reconfigure: Watch,
+        metrics=None,
+    ):
+        self.worker_id = worker_id
+        self.store = store
+        self.rx_batch = rx_batch
+        self.tx_digest = tx_digest
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+        self.metrics = metrics
+
+    def spawn(self) -> asyncio.Task:
+        return asyncio.ensure_future(self.run())
+
+    async def run(self) -> None:
+        while True:
+            serialized, own = await self.rx_batch.recv()
+            if self.rx_reconfigure.peek().kind == "shutdown":
+                return
+            digest = serialized_batch_digest(serialized)
+            self.store.write(digest, serialized)
+            msg = (
+                OurBatchMsg(digest, self.worker_id)
+                if own
+                else OthersBatchMsg(digest, self.worker_id)
+            )
+            await self.tx_digest.send(msg)

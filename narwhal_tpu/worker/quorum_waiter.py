@@ -1,0 +1,91 @@
+"""QuorumWaiter: reliably disseminate a batch and wait for 2f+1 stake.
+
+Reference: /root/reference/worker/src/quorum_waiter.rs:39-157 — broadcast the
+serialized batch to the same-id worker of every other authority via reliable
+send, sum acked stake (own stake counts) until quorum_threshold, then forward
+the batch onward to the Processor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel, Subscriber, Watch
+from ..config import Committee, WorkerCache
+from ..messages import WorkerBatchMsg
+from ..network import NetworkClient
+from ..types import Batch, PublicKey, WorkerId
+
+logger = logging.getLogger("narwhal.worker")
+
+
+class QuorumWaiter:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_id: WorkerId,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        network: NetworkClient,
+        rx_message: Channel,
+        tx_batch: Channel,
+        rx_reconfigure: Watch,
+    ):
+        self.name = name
+        self.worker_id = worker_id
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.network = network
+        self.rx_message = rx_message
+        self.tx_batch = tx_batch
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+
+    def spawn(self) -> asyncio.Task:
+        return asyncio.ensure_future(self.run())
+
+    async def run(self) -> None:
+        while True:
+            batch: Batch = await self.rx_message.recv()
+            if self.rx_reconfigure.peek().kind == "shutdown":
+                return
+            serialized = batch.to_bytes()
+            others = self.worker_cache.others_workers(self.name, self.worker_id)
+            msg = WorkerBatchMsg(serialized)
+            handles = [
+                (self.committee.stake(pk), self.network.send(info.worker_address, msg))
+                for pk, info in others
+            ]
+
+            total = self.committee.stake(self.name)  # our own vote
+            threshold = self.committee.quorum_threshold()
+            pending = {
+                asyncio.ensure_future(self._wait(stake, h)): stake
+                for stake, h in handles
+            }
+            try:
+                while total < threshold and pending:
+                    done, _ = await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    for t in done:
+                        total += t.result()
+                        pending.pop(t, None)
+            finally:
+                # Remaining reliable sends keep retrying in the background
+                # (the reference lets its CancelOnDrop handles continue until
+                # the waiter future set is dropped after quorum).
+                for t in pending:
+                    t.cancel()
+            if total >= threshold:
+                await self.tx_batch.send((serialized, True))
+            else:
+                logger.warning("batch dissemination failed to reach quorum")
+
+    @staticmethod
+    async def _wait(stake: int, handle) -> int:
+        try:
+            await handle
+            return stake
+        except asyncio.CancelledError:
+            return 0

@@ -1,0 +1,151 @@
+"""Worker Synchronizer: fetch missing batches on the primary's behalf.
+
+Reference: /root/reference/worker/src/synchronizer.rs:77-384 — executes the
+primary's Synchronize command by asking the target authority's same-id worker
+for the missing batches, retrying on a timer via lucky_broadcast to
+`sync_retry_nodes` random peers; handles Cleanup(round) GC of stale requests
+and DeleteBatches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..channels import Channel, Subscriber, Watch
+from ..config import Committee, Parameters, WorkerCache
+from ..messages import SynchronizeMsg, WorkerBatchRequest, WorkerBatchResponse
+from ..network import NetworkClient, RpcError
+from ..stores import BatchStore
+from ..types import Digest, PublicKey, Round, WorkerId, serialized_batch_digest
+
+logger = logging.getLogger("narwhal.worker")
+
+
+class WorkerSynchronizer:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_id: WorkerId,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        parameters: Parameters,
+        store: BatchStore,
+        network: NetworkClient,
+        rx_command: Channel,
+        tx_batch_processor: Channel,
+        rx_reconfigure: Watch,
+        metrics=None,
+    ):
+        self.name = name
+        self.worker_id = worker_id
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.parameters = parameters
+        self.store = store
+        self.network = network
+        self.rx_command = rx_command
+        self.tx_batch_processor = tx_batch_processor
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+        self.metrics = metrics
+        # digest -> (deadline round, target authority, request time)
+        self.pending: dict[Digest, tuple[Round, PublicKey, float]] = {}
+        self.gc_round: Round = 0
+
+    def spawn(self) -> asyncio.Task:
+        return asyncio.ensure_future(self.run())
+
+    async def run(self) -> None:
+        timer = asyncio.ensure_future(asyncio.sleep(self.parameters.sync_retry_delay))
+        cmd = asyncio.ensure_future(self.rx_command.recv())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    {timer, cmd}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if self.rx_reconfigure.peek().kind == "shutdown":
+                    return
+                if cmd in done:
+                    msg = cmd.result()
+                    cmd = asyncio.ensure_future(self.rx_command.recv())
+                    if isinstance(msg, SynchronizeMsg):
+                        await self._synchronize(msg)
+                    else:  # Cleanup round
+                        self._cleanup(msg)
+                if timer in done:
+                    timer = asyncio.ensure_future(
+                        asyncio.sleep(self.parameters.sync_retry_delay)
+                    )
+                    await self._retry()
+        finally:
+            timer.cancel()
+            cmd.cancel()
+
+    async def _synchronize(self, msg: SynchronizeMsg) -> None:
+        missing = [d for d in msg.digests if not self.store.contains(d)]
+        now = time.monotonic()
+        for d in missing:
+            self.pending[d] = (self.gc_round, msg.target, now)
+        if self.metrics is not None:
+            self.metrics.pending_sync_batches.set(len(self.pending))
+        if not missing:
+            return
+        try:
+            info = self.worker_cache.worker(msg.target, self.worker_id)
+        except KeyError:
+            logger.warning("synchronize target has no worker %d", self.worker_id)
+            return
+        asyncio.ensure_future(self._fetch(info.worker_address, tuple(missing)))
+
+    async def _fetch(self, address: str, digests: tuple[Digest, ...]) -> None:
+        """One fetch attempt; received batches flow through the others-batch
+        processor path, which stores them and notifies the primary."""
+        try:
+            resp: WorkerBatchResponse = await self.network.request(
+                address, WorkerBatchRequest(digests), timeout=5.0
+            )
+        except (RpcError, OSError):
+            return  # the retry timer will lucky-broadcast
+        for serialized in resp.batches:
+            digest = serialized_batch_digest(serialized)
+            self.pending.pop(digest, None)
+            await self.tx_batch_processor.send((serialized, False))
+        if self.metrics is not None:
+            self.metrics.pending_sync_batches.set(len(self.pending))
+
+    async def _retry(self) -> None:
+        still_missing = []
+        for d in list(self.pending):
+            if self.store.contains(d):
+                self.pending.pop(d, None)
+            else:
+                still_missing.append(d)
+        if not still_missing:
+            if self.metrics is not None:
+                self.metrics.pending_sync_batches.set(0)
+            return
+        # Lucky broadcast the whole want-list to a few random same-id workers
+        # (synchronizer.rs:311-345).
+        addresses = [
+            info.worker_address
+            for _, info in self.worker_cache.others_workers(self.name, self.worker_id)
+        ]
+        if not addresses:
+            return
+        import random
+
+        chosen = random.sample(
+            addresses, min(self.parameters.sync_retry_nodes, len(addresses))
+        )
+        for addr in chosen:
+            asyncio.ensure_future(self._fetch(addr, tuple(still_missing)))
+
+    def _cleanup(self, round: Round) -> None:
+        """Drop pending requests from before the GC round
+        (synchronizer.rs:215-282)."""
+        self.gc_round = max(self.gc_round, round)
+        for d in [d for d, (r, _, _) in self.pending.items() if r < self.gc_round]:
+            self.pending.pop(d, None)
+        if self.metrics is not None:
+            self.metrics.pending_sync_batches.set(len(self.pending))

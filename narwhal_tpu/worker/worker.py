@@ -1,0 +1,226 @@
+"""Worker assembly: networks, tx ingest, and the batch pipeline actors.
+
+Reference: /root/reference/worker/src/worker.rs:57-211 (spawn),
+TxReceiverHandler :352-423, WorkerReceiverHandler :426-466,
+PrimaryReceiverHandler (Synchronize/Cleanup/RequestBatch/DeleteBatches/
+Reconfigure) routed through the synchronizer.
+
+One RPC server on `worker_address` carries both the worker<->worker plane and
+the primary->worker plane; a second server on `transactions` is the
+client-facing tx ingest (the tonic Transactions service analog). A design
+delta: RequestBatch and DeleteBatches are served as direct RPC responses
+instead of loose WorkerToPrimary messages — same capability, one less round
+trip (the reference's BlockWaiter matches responses manually,
+primary/src/block_waiter.rs:549-).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel, Watch
+from ..config import Committee, Parameters, WorkerCache
+from ..messages import (
+    CleanupMsg,
+    DeleteBatchesMsg,
+    DeletedBatchesMsg,
+    ReconfigureMsg,
+    RequestBatchMsg,
+    RequestedBatchMsg,
+    SubmitTransactionMsg,
+    SubmitTransactionStreamMsg,
+    SynchronizeMsg,
+    WorkerBatchMsg,
+    WorkerBatchRequest,
+    WorkerBatchResponse,
+)
+from ..metrics import Registry
+from ..network import NetworkClient, RpcServer
+from ..stores import BatchStore
+from ..types import Batch, PublicKey, ReconfigureNotification, WorkerId
+from .batch_maker import BatchMaker
+from .metrics import WorkerMetrics
+from .primary_connector import PrimaryConnector
+from .processor import Processor
+from .quorum_waiter import QuorumWaiter
+from .synchronizer import WorkerSynchronizer
+
+logger = logging.getLogger("narwhal.worker")
+
+
+class Worker:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_id: WorkerId,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        parameters: Parameters,
+        store: BatchStore,
+        registry: Registry | None = None,
+        benchmark: bool = False,
+    ):
+        self.name = name
+        self.worker_id = worker_id
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.parameters = parameters
+        self.store = store
+        self.registry = registry or Registry()
+        self.metrics = WorkerMetrics(self.registry)
+        self.benchmark = benchmark
+
+        self.network = NetworkClient()
+        self.server = RpcServer(parameters.max_concurrent_requests)
+        self.tx_server = RpcServer(parameters.max_concurrent_requests)
+        self.rx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
+        self._tasks: list[asyncio.Task] = []
+
+        # Channels (worker/src/worker.rs:229-346 wiring).
+        self.tx_batch_maker = Channel(10_000)
+        self.tx_quorum_waiter = Channel(1_000)
+        self.tx_processor = Channel(1_000)
+        self.tx_others_processor = Channel(1_000)
+        self.tx_digest = Channel(10_000)
+        self.tx_sync_command = Channel(1_000)
+
+    async def spawn(self) -> None:
+        me = self.worker_cache.worker(self.name, self.worker_id)
+        host, port = me.worker_address.rsplit(":", 1)
+        bound = await self.server.start(host, int(port))
+        self.worker_address = f"{host}:{bound}"
+        thost, tport = me.transactions.rsplit(":", 1)
+        tbound = await self.tx_server.start(thost, int(tport))
+        self.transactions_address = f"{thost}:{tbound}"
+
+        # Route the three planes.
+        self.server.route(WorkerBatchMsg, self._on_peer_batch)
+        self.server.route(WorkerBatchRequest, self._on_batch_request)
+        self.server.route(SynchronizeMsg, self._on_synchronize)
+        self.server.route(CleanupMsg, self._on_cleanup)
+        self.server.route(RequestBatchMsg, self._on_request_batch)
+        self.server.route(DeleteBatchesMsg, self._on_delete_batches)
+        self.server.route(ReconfigureMsg, self._on_reconfigure)
+        self.tx_server.route(SubmitTransactionMsg, self._on_tx)
+        self.tx_server.route(SubmitTransactionStreamMsg, self._on_tx_stream)
+
+        primary_address = self.committee.primary_address(self.name)
+
+        self._tasks = [
+            BatchMaker(
+                self.parameters.batch_size,
+                self.parameters.max_batch_delay,
+                self.tx_batch_maker,
+                self.tx_quorum_waiter,
+                self.rx_reconfigure,
+                self.metrics,
+                self.benchmark,
+            ).spawn(),
+            QuorumWaiter(
+                self.name,
+                self.worker_id,
+                self.committee,
+                self.worker_cache,
+                self.network,
+                self.tx_quorum_waiter,
+                self.tx_processor,
+                self.rx_reconfigure,
+            ).spawn(),
+            Processor(
+                self.worker_id,
+                self.store,
+                self.tx_processor,
+                self.tx_digest,
+                self.rx_reconfigure,
+                self.metrics,
+            ).spawn(),
+            Processor(
+                self.worker_id,
+                self.store,
+                self.tx_others_processor,
+                self.tx_digest,
+                self.rx_reconfigure,
+                self.metrics,
+            ).spawn(),
+            PrimaryConnector(
+                primary_address, self.network, self.tx_digest, self.rx_reconfigure
+            ).spawn(),
+            WorkerSynchronizer(
+                self.name,
+                self.worker_id,
+                self.committee,
+                self.worker_cache,
+                self.parameters,
+                self.store,
+                self.network,
+                self.tx_sync_command,
+                self.tx_others_processor,
+                self.rx_reconfigure,
+                self.metrics,
+            ).spawn(),
+        ]
+        # Benchmark-parsed boot line (worker/src/worker.rs:194-204).
+        logger.info(
+            "Worker %d successfully booted on %s", self.worker_id,
+            self.transactions_address,
+        )
+
+    # -- handlers ---------------------------------------------------------
+    async def _on_peer_batch(self, msg: WorkerBatchMsg, peer: str):
+        self.metrics.batches_received.inc()
+        await self.tx_others_processor.send((msg.serialized_batch, False))
+        return None
+
+    async def _on_batch_request(self, msg: WorkerBatchRequest, peer: str):
+        found = []
+        for d in msg.digests:
+            raw = self.store.read(d)
+            if raw is not None:
+                found.append(raw)
+        return WorkerBatchResponse(tuple(found))
+
+    async def _on_synchronize(self, msg: SynchronizeMsg, peer: str):
+        await self.tx_sync_command.send(msg)
+        return None
+
+    async def _on_cleanup(self, msg: CleanupMsg, peer: str):
+        await self.tx_sync_command.send(msg.round)
+        return None
+
+    async def _on_request_batch(self, msg: RequestBatchMsg, peer: str):
+        raw = self.store.read(msg.digest)
+        txs = Batch.from_bytes(raw).transactions if raw is not None else ()
+        return RequestedBatchMsg(msg.digest, txs)
+
+    async def _on_delete_batches(self, msg: DeleteBatchesMsg, peer: str):
+        self.store.delete_all(msg.digests)
+        return DeletedBatchesMsg(msg.digests)
+
+    async def _on_reconfigure(self, msg: ReconfigureMsg, peer: str):
+        committee = msg.committee()
+        if committee is not None:
+            self.committee = committee
+        self.rx_reconfigure.send(ReconfigureNotification(msg.kind, committee))
+        return None
+
+    async def _on_tx(self, msg: SubmitTransactionMsg, peer: str):
+        self.metrics.tx_received.inc()
+        await self.tx_batch_maker.send(msg.transaction)
+        return None
+
+    async def _on_tx_stream(self, msg: SubmitTransactionStreamMsg, peer: str):
+        for tx in msg.transactions:
+            self.metrics.tx_received.inc()
+            await self.tx_batch_maker.send(tx)
+        return None
+
+    # -- lifecycle --------------------------------------------------------
+    async def shutdown(self) -> None:
+        self.rx_reconfigure.send(ReconfigureNotification("shutdown"))
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.server.stop()
+        await self.tx_server.stop()
+        self.network.close()

@@ -1,0 +1,213 @@
+"""Worker pipeline tests, mirroring /root/reference/worker/src/tests/
+{batch_maker,quorum_waiter,processor,synchronizer,worker}_tests.rs."""
+
+import asyncio
+
+from narwhal_tpu.channels import Channel, Watch
+from narwhal_tpu.fixtures import CommitteeFixture
+from narwhal_tpu.messages import (
+    OthersBatchMsg,
+    OurBatchMsg,
+    RequestBatchMsg,
+    SubmitTransactionMsg,
+    SubmitTransactionStreamMsg,
+    SynchronizeMsg,
+    WorkerBatchMsg,
+    WorkerBatchRequest,
+)
+from narwhal_tpu.network import NetworkClient, RpcServer
+from narwhal_tpu.stores import NodeStorage
+from narwhal_tpu.types import Batch, ReconfigureNotification, serialized_batch_digest
+from narwhal_tpu.worker import Worker
+from narwhal_tpu.worker.batch_maker import BatchMaker
+
+
+def _watch():
+    return Watch(ReconfigureNotification("boot"))
+
+
+def test_batch_maker_seals_on_size(run):
+    async def scenario():
+        rx, tx_out = Channel(100), Channel(10)
+        bm = BatchMaker(100, 10.0, rx, tx_out, _watch())
+        task = bm.spawn()
+        for i in range(4):
+            await rx.send(bytes([i]) * 30)  # 120 B total > 100
+        batch = await asyncio.wait_for(tx_out.recv(), 2.0)
+        assert isinstance(batch, Batch)
+        assert batch.size_bytes >= 100
+        task.cancel()
+
+    run(scenario())
+
+
+def test_batch_maker_seals_on_timer(run):
+    async def scenario():
+        rx, tx_out = Channel(100), Channel(10)
+        bm = BatchMaker(1_000_000, 0.05, rx, tx_out, _watch())
+        task = bm.spawn()
+        await rx.send(b"lonely-tx")
+        batch = await asyncio.wait_for(tx_out.recv(), 2.0)
+        assert batch.transactions == (b"lonely-tx",)
+        task.cancel()
+
+    run(scenario())
+
+
+async def _spawn_committee_workers(f, benchmark=False):
+    """Boot one worker per authority on ephemeral ports, patching the shared
+    worker cache with the bound addresses (the fixture uses port 0)."""
+    workers = []
+    for a in f.authorities:
+        w = Worker(
+            a.public, 0, f.committee, f.worker_cache,
+            f.parameters, NodeStorage(None).batch_store, benchmark=benchmark,
+        )
+        await w.spawn()
+        info = f.worker_cache.workers[a.public][0]
+        from narwhal_tpu.config import WorkerInfo
+
+        f.worker_cache.workers[a.public][0] = WorkerInfo(
+            name=info.name,
+            transactions=w.transactions_address,
+            worker_address=w.worker_address,
+        )
+        workers.append(w)
+    return workers
+
+
+def test_worker_batch_dissemination_e2e(run):
+    """Submit txs to one worker; every worker ends with the batch in its
+    store, and the submitting worker's primary hears OurBatch while peers'
+    primaries hear OthersBatch."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        # Mock primaries: tiny RPC servers collecting digest notifications
+        # (the reference's WorkerToPrimaryMockServer, test_utils/src/lib.rs).
+        primary_chans = {}
+        primary_servers = []
+        for i, a in enumerate(f.authorities):
+            srv = RpcServer()
+            ch = Channel(100)
+
+            def mk(ch_):
+                async def on(msg, peer):
+                    await ch_.send(msg)
+
+                return on
+
+            srv.route(OurBatchMsg, mk(ch))
+            srv.route(OthersBatchMsg, mk(ch))
+            port = await srv.start("127.0.0.1", 0)
+            # point the committee's primary address at the mock
+            from narwhal_tpu.config import Authority
+
+            auth = f.committee.authorities[a.public]
+            f.committee.authorities[a.public] = Authority(
+                auth.stake, f"127.0.0.1:{port}", auth.network_key
+            )
+            primary_chans[a.public] = ch
+            primary_servers.append(srv)
+
+        f.parameters.batch_size = 60
+        f.parameters.max_batch_delay = 0.05
+        workers = await _spawn_committee_workers(f)
+
+        # submit enough txs to worker 0 to seal a batch
+        client = NetworkClient()
+        for i in range(4):
+            await client.request(
+                workers[0].transactions_address, SubmitTransactionMsg(bytes([1, i]) * 10)
+            )
+
+        # worker 0's primary hears OurBatch
+        sender = workers[0].name
+        our = await asyncio.wait_for(primary_chans[sender].recv(), 5.0)
+        assert isinstance(our, OurBatchMsg)
+        # peers' primaries hear OthersBatch with the same digest
+        for a in f.authorities:
+            if a.public == sender:
+                continue
+            got = await asyncio.wait_for(primary_chans[a.public].recv(), 5.0)
+            assert isinstance(got, OthersBatchMsg)
+            assert got.digest == our.digest
+        # every worker stored the batch
+        for w in workers:
+            assert w.store.contains(our.digest)
+
+        for w in workers:
+            await w.shutdown()
+        for s in primary_servers:
+            await s.stop()
+        client.close()
+
+    run(scenario())
+
+
+def test_worker_synchronize_fetches_missing(run):
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        f.parameters.sync_retry_delay = 0.2
+        workers = await _spawn_committee_workers(f)
+
+        # Plant a batch only in worker 1's store.
+        batch = Batch((b"planted-tx",))
+        serialized = batch.to_bytes()
+        workers[1].store.write(batch.digest, serialized)
+
+        # Ask worker 0 to synchronize it from worker 1's authority.
+        client = NetworkClient()
+        await client.request(
+            workers[0].worker_address,
+            SynchronizeMsg((batch.digest,), workers[1].name),
+        )
+        for _ in range(100):
+            if workers[0].store.contains(batch.digest):
+                break
+            await asyncio.sleep(0.05)
+        assert workers[0].store.contains(batch.digest)
+
+        # RequestBatch RPC returns the transactions.
+        resp = await client.request(
+            workers[0].worker_address, RequestBatchMsg(batch.digest)
+        )
+        assert resp.transactions == (b"planted-tx",)
+
+        for w in workers:
+            await w.shutdown()
+        client.close()
+
+    run(scenario())
+
+
+def test_worker_synchronize_retry_via_lucky_broadcast(run):
+    """Target authority doesn't have the batch; a retry tick finds it on
+    another peer."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        f.parameters.sync_retry_delay = 0.15
+        f.parameters.sync_retry_nodes = 3
+        workers = await _spawn_committee_workers(f)
+
+        batch = Batch((b"elsewhere",))
+        workers[2].store.write(batch.digest, batch.to_bytes())
+        workers[3].store.write(batch.digest, batch.to_bytes())
+
+        client = NetworkClient()
+        # ask to sync from authority 1, which does NOT have it
+        await client.request(
+            workers[0].worker_address, SynchronizeMsg((batch.digest,), workers[1].name)
+        )
+        for _ in range(100):
+            if workers[0].store.contains(batch.digest):
+                break
+            await asyncio.sleep(0.05)
+        assert workers[0].store.contains(batch.digest)
+
+        for w in workers:
+            await w.shutdown()
+        client.close()
+
+    run(scenario())
