@@ -1,0 +1,64 @@
+"""Stake-weighted vote and certificate accumulation.
+
+Reference: /root/reference/primary/src/aggregators.rs:16-99 — VotesAggregator
+turns a quorum of votes over our header into a Certificate; one
+CertificatesAggregator per round turns a quorum of certificates into the next
+round's parent set.
+"""
+
+from __future__ import annotations
+
+from ..config import Committee
+from ..types import Certificate, Digest, Header, Vote
+
+
+class VotesAggregator:
+    """Collects votes for one of our headers; yields the certificate once the
+    accumulated stake (author's own stake included, counted at append of the
+    author's implicit self-vote) reaches quorum
+    (/root/reference/primary/src/aggregators.rs:16-57)."""
+
+    def __init__(self) -> None:
+        self.weight = 0
+        self.votes: list[tuple[int, bytes]] = []  # (committee index, signature)
+        self.seen: set[bytes] = set()  # voter public keys
+        self.done = False
+
+    def append(
+        self, vote: Vote, committee: Committee, header: Header
+    ) -> Certificate | None:
+        if self.done or vote.author in self.seen:
+            return None
+        self.seen.add(vote.author)
+        self.votes.append((committee.index_of(vote.author), vote.signature))
+        self.weight += committee.stake(vote.author)
+        if self.weight >= committee.quorum_threshold():
+            self.done = True
+            signers, sigs = zip(*sorted(self.votes))
+            return Certificate(header, tuple(signers), tuple(sigs))
+        return None
+
+
+class CertificatesAggregator:
+    """Collects certificates of one round; yields the parent digest set once
+    their combined stake reaches quorum
+    (/root/reference/primary/src/aggregators.rs:59-99)."""
+
+    def __init__(self) -> None:
+        self.weight = 0
+        self.certificates: list[Certificate] = []
+        self.seen: set[bytes] = set()  # origins
+        self.done = False
+
+    def append(
+        self, certificate: Certificate, committee: Committee
+    ) -> list[Certificate] | None:
+        if self.done or certificate.origin in self.seen:
+            return None
+        self.seen.add(certificate.origin)
+        self.certificates.append(certificate)
+        self.weight += committee.stake(certificate.origin)
+        if self.weight >= committee.quorum_threshold():
+            self.done = True
+            return list(self.certificates)
+        return None

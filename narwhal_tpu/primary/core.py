@@ -1,0 +1,389 @@
+"""The Core: header/vote/certificate protocol state machine.
+
+Reference: /root/reference/primary/src/core.rs:36-715 — processes our own
+headers (store, broadcast, self-vote), peers' headers (sanitize → parents &
+payload availability → equivocation-protected vote), votes (stake aggregation
+→ certificate assembly → broadcast), and certificates (causal-completeness
+check → store → per-round quorum aggregation feeding the proposer → feed to
+consensus). Garbage collection follows consensus round updates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel, Subscriber, Watch
+from ..config import Committee, WorkerCache
+from ..crypto import SignatureService
+from ..network import NetworkClient
+from ..stores import CertificateStore, HeaderStore, VoteDigestStore
+from ..types import (
+    Certificate,
+    DagError,
+    Digest,
+    Header,
+    InvalidEpoch,
+    PublicKey,
+    Round,
+    TooOld,
+    Vote,
+)
+from .aggregators import CertificatesAggregator, VotesAggregator
+from .synchronizer import Synchronizer
+
+logger = logging.getLogger("narwhal.primary")
+
+
+class Core:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        header_store: HeaderStore,
+        certificate_store: CertificateStore,
+        vote_digest_store: VoteDigestStore,
+        synchronizer: Synchronizer,
+        signature_service: SignatureService,
+        network: NetworkClient,
+        rx_primaries: Channel,  # Header | Vote | Certificate from peers
+        rx_header_waiter: Channel,  # replayed headers whose deps arrived
+        rx_certificate_waiter: Channel,  # replayed certificates
+        rx_proposer: Channel,  # our own freshly built headers
+        tx_consensus: Channel,
+        tx_proposer: Channel,  # (parent certs, round, epoch)
+        rx_consensus_round_updates: Watch,  # committed round for GC
+        gc_depth: Round,
+        rx_reconfigure: Watch,
+        metrics=None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.header_store = header_store
+        self.certificate_store = certificate_store
+        self.vote_digest_store = vote_digest_store
+        self.synchronizer = synchronizer
+        self.signature_service = signature_service
+        self.network = network
+        self.rx_primaries = rx_primaries
+        self.rx_header_waiter = rx_header_waiter
+        self.rx_certificate_waiter = rx_certificate_waiter
+        self.rx_proposer = rx_proposer
+        self.tx_consensus = tx_consensus
+        self.tx_proposer = tx_proposer
+        self.rx_consensus_round_updates = Subscriber(rx_consensus_round_updates)
+        self.gc_depth = gc_depth
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+        self.metrics = metrics
+
+        self.gc_round: Round = 0
+        self.highest_received_round: Round = 0
+        self.current_header: Header | None = None
+        self.votes_aggregator = VotesAggregator()
+        self.certificates_aggregators: dict[Round, CertificatesAggregator] = {}
+        self.processing: dict[Round, set[Digest]] = {}
+        # Reliable-send handles by round, dropped (cancelled) at GC so a dead
+        # peer can't accumulate retry-forever tasks (core.rs cancel_handlers).
+        self.cancel_handlers: dict[Round, list] = {}
+        # Channel the certificate waiter listens on; set by the assembly.
+        self.tx_certificate_waiter: Channel | None = None
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self.run())
+        return self._task
+
+    # ------------------------------------------------------------------
+    # Own-header path (core.rs:149-179)
+    # ------------------------------------------------------------------
+    async def process_own_header(self, header: Header) -> None:
+        self.current_header = header
+        self.votes_aggregator = VotesAggregator()
+        from ..messages import HeaderMsg
+
+        addresses = [addr for _, addr, _ in self.committee.others_primaries(self.name)]
+        handlers = self.network.broadcast(addresses, HeaderMsg(header))
+        self.cancel_handlers.setdefault(header.round, []).extend(handlers)
+        await self.process_header(header)
+
+    # ------------------------------------------------------------------
+    # Header path (core.rs:183-355)
+    # ------------------------------------------------------------------
+    async def process_header(self, header: Header) -> None:
+        self.processing.setdefault(header.round, set()).add(header.digest)
+
+        # Causal completeness: parents must be certified and local
+        # (core.rs:200-231). The synchronizer queues repair + loopback.
+        parents = await self.synchronizer.get_parents(header)
+        if parents is None:
+            logger.debug("Header %s suspended: missing parents", header.digest.hex()[:16])
+            if self.metrics is not None:
+                self.metrics.headers_suspended.inc()
+            return
+        if not header.parents.issubset(self.synchronizer.genesis_digests):
+            stake = sum(self.committee.stake(p.origin) for p in parents)
+            if any(p.round + 1 != header.round for p in parents):
+                raise DagError(f"header {header.digest.hex()[:16]} has malformed parents")
+            if stake < self.committee.quorum_threshold():
+                raise DagError(
+                    f"header {header.digest.hex()[:16]} lacks parent quorum"
+                )
+
+        # Payload availability (core.rs:233-246).
+        if await self.synchronizer.missing_payload(header):
+            logger.debug("Header %s suspended: missing payload", header.digest.hex()[:16])
+            if self.metrics is not None:
+                self.metrics.headers_suspended.inc()
+            return
+
+        self.header_store.write(header)
+        if self.metrics is not None:
+            self.metrics.headers_processed.inc()
+
+        # Equivocation-protected voting (core.rs:281-308): vote at most once
+        # per (origin, round), persistently.
+        last = self.vote_digest_store.read(header.author)
+        if last is not None:
+            last_round, last_digest = last
+            if header.round < last_round:
+                return
+            if header.round == last_round and last_digest != header.digest:
+                logger.warning(
+                    "Authority %s equivocated at round %s",
+                    header.author.hex()[:16],
+                    header.round,
+                )
+                return
+            if header.round == last_round and last_digest == header.digest and header.author != self.name:
+                pass  # re-vote the same header is safe (vote may have been lost)
+        self.vote_digest_store.write(header.author, header.round, header.digest)
+
+        vote = Vote.for_header(header, self.name, self.signature_service)
+        if header.author == self.name:
+            await self.process_vote(vote)
+        else:
+            from ..messages import VoteMsg
+
+            address = self.committee.primary_address(header.author)
+            handler = self.network.send(address, VoteMsg(vote))
+            self.cancel_handlers.setdefault(header.round, []).append(handler)
+            if self.metrics is not None:
+                self.metrics.votes_sent.inc()
+
+    # ------------------------------------------------------------------
+    # Vote path (core.rs:359-396)
+    # ------------------------------------------------------------------
+    async def process_vote(self, vote: Vote) -> None:
+        if self.current_header is None or vote.header_digest != self.current_header.digest:
+            return  # vote for an old header of ours
+        certificate = self.votes_aggregator.append(
+            vote, self.committee, self.current_header
+        )
+        if self.metrics is not None:
+            self.metrics.votes_processed.inc()
+        if certificate is not None:
+            logger.debug(
+                "Assembled certificate %s round %s",
+                certificate.digest.hex()[:16],
+                certificate.round,
+            )
+            if self.metrics is not None:
+                self.metrics.certificates_created.inc()
+            from ..messages import CertificateMsg
+
+            addresses = [
+                addr for _, addr, _ in self.committee.others_primaries(self.name)
+            ]
+            handlers = self.network.broadcast(addresses, CertificateMsg(certificate))
+            self.cancel_handlers.setdefault(certificate.round, []).extend(handlers)
+            await self.process_certificate(certificate)
+
+    # ------------------------------------------------------------------
+    # Certificate path (core.rs:400-494)
+    # ------------------------------------------------------------------
+    async def process_certificate(self, certificate: Certificate) -> None:
+        # Process the embedded header if we haven't seen it: its quorum of
+        # signers proves the data exists, but we still want our local copy of
+        # payload/parents fetched (core.rs:404-417).
+        if certificate.header.digest not in self.processing.get(
+            certificate.header.round, set()
+        ):
+            await self.process_header(certificate.header)
+
+        # Ancestry must be locally complete before the DAG accepts it; the
+        # certificate waiter replays it once parents arrive (core.rs:419-431).
+        if not certificate.is_genesis() and not self.synchronizer.deliver_certificate(
+            certificate
+        ):
+            logger.debug(
+                "Certificate %s suspended: missing ancestors",
+                certificate.digest.hex()[:16],
+            )
+            if self.metrics is not None:
+                self.metrics.certificates_suspended.inc()
+            if self.tx_certificate_waiter is not None:
+                await self.tx_certificate_waiter.send(certificate)
+            return
+
+        self.certificate_store.write(certificate)
+        if self.metrics is not None:
+            self.metrics.certificates_processed.inc()
+
+        # Enough certificates at this round => next-round parents for the
+        # proposer (core.rs:445-461).
+        aggregator = self.certificates_aggregators.setdefault(
+            certificate.round, CertificatesAggregator()
+        )
+        parents = aggregator.append(certificate, self.committee)
+        if parents is not None:
+            await self.tx_proposer.send(
+                (parents, certificate.round, certificate.epoch)
+            )
+
+        await self.tx_consensus.send(certificate)
+
+    # ------------------------------------------------------------------
+    # Sanitization (core.rs:497-573)
+    # ------------------------------------------------------------------
+    def sanitize_header(self, header: Header) -> None:
+        if header.epoch != self.committee.epoch:
+            raise InvalidEpoch(f"header from epoch {header.epoch}")
+        if header.round <= self.gc_round:
+            raise TooOld(f"header round {header.round} <= gc {self.gc_round}")
+        header.verify(self.committee, self.worker_cache)
+
+    def sanitize_vote(self, vote: Vote) -> None:
+        if vote.epoch != self.committee.epoch:
+            raise InvalidEpoch(f"vote from epoch {vote.epoch}")
+        if self.current_header is None or vote.round < self.current_header.round:
+            raise TooOld(f"vote for stale round {vote.round}")
+        vote.verify(self.committee)
+
+    def sanitize_certificate(self, certificate: Certificate) -> None:
+        if certificate.epoch != self.committee.epoch:
+            raise InvalidEpoch(f"certificate from epoch {certificate.epoch}")
+        if certificate.round < self.gc_round:
+            raise TooOld(
+                f"certificate round {certificate.round} < gc {self.gc_round}"
+            )
+        certificate.verify(self.committee, self.worker_cache)
+
+    def _observe_round(self, round: Round) -> None:
+        """Track the highest round seen for metrics (core.rs:434-443)."""
+        if round > self.highest_received_round:
+            self.highest_received_round = round
+
+    # ------------------------------------------------------------------
+    # Main loop (core.rs:615-715)
+    # ------------------------------------------------------------------
+    async def _handle_message(self, msg) -> None:
+        try:
+            if isinstance(msg, Header):
+                self.sanitize_header(msg)
+                self._observe_round(msg.round)
+                await self.process_header(msg)
+            elif isinstance(msg, Vote):
+                self.sanitize_vote(msg)
+                await self.process_vote(msg)
+            elif isinstance(msg, Certificate):
+                self.sanitize_certificate(msg)
+                self._observe_round(msg.round)
+                await self.process_certificate(msg)
+            else:
+                logger.warning("Core received unexpected %r", type(msg))
+        except (InvalidEpoch, TooOld) as e:
+            logger.debug("Dropped stale message: %s", e)
+        except DagError as e:
+            logger.warning("Rejected message: %s", e)
+
+    async def _gc(self, committed_round: Round) -> None:
+        if committed_round <= self.gc_depth:
+            return
+        gc_round = committed_round - self.gc_depth
+        if gc_round <= self.gc_round:
+            return
+        self.gc_round = gc_round
+        for r in [r for r in self.processing if r <= gc_round]:
+            del self.processing[r]
+        for r in [r for r in self.certificates_aggregators if r <= gc_round]:
+            del self.certificates_aggregators[r]
+        for r in [r for r in self.cancel_handlers if r <= gc_round]:
+            for handler in self.cancel_handlers.pop(r):
+                handler.cancel()
+        if self.metrics is not None:
+            self.metrics.gc_round.set(gc_round)
+
+    async def run(self) -> None:
+        channels = {
+            "primaries": self.rx_primaries,
+            "header_waiter": self.rx_header_waiter,
+            "certificate_waiter": self.rx_certificate_waiter,
+            "proposer": self.rx_proposer,
+        }
+        tasks = {
+            key: asyncio.ensure_future(ch.recv()) for key, ch in channels.items()
+        }
+        recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
+        round_task = asyncio.ensure_future(self.rx_consensus_round_updates.changed())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    set(tasks.values()) | {recon_task, round_task},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if recon_task in done:
+                    note = recon_task.result()
+                    if note.kind == "shutdown":
+                        return
+                    if note.committee is not None:
+                        self.change_epoch(note.committee)
+                    recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
+                if round_task in done:
+                    committed_round = round_task.result()
+                    round_task = asyncio.ensure_future(
+                        self.rx_consensus_round_updates.changed()
+                    )
+                    await self._gc(committed_round)
+                for key, ch in channels.items():
+                    task = tasks[key]
+                    if task not in done:
+                        continue
+                    msg = task.result()
+                    tasks[key] = asyncio.ensure_future(ch.recv())
+                    if key == "proposer":
+                        await self.process_own_header(msg)
+                    elif key in ("header_waiter",):
+                        # Replayed headers were sanitized on first receipt.
+                        try:
+                            await self.process_header(msg)
+                        except DagError as e:
+                            logger.warning("Replayed header rejected: %s", e)
+                    elif key == "certificate_waiter":
+                        try:
+                            await self.process_certificate(msg)
+                        except DagError as e:
+                            logger.warning("Replayed certificate rejected: %s", e)
+                    else:
+                        await self._handle_message(msg)
+        finally:
+            for t in tasks.values():
+                t.cancel()
+            recon_task.cancel()
+            round_task.cancel()
+
+    def change_epoch(self, committee: Committee) -> None:
+        """(core.rs:592-611): fresh per-epoch volatile state."""
+        self.committee = committee
+        self.gc_round = 0
+        self.highest_received_round = 0
+        self.current_header = None
+        self.votes_aggregator = VotesAggregator()
+        self.certificates_aggregators.clear()
+        self.processing.clear()
+        for handlers in self.cancel_handlers.values():
+            for handler in handlers:
+                handler.cancel()
+        self.cancel_handlers.clear()
+        self.synchronizer.update_genesis(self.committee)

@@ -1,0 +1,52 @@
+"""Primary metrics (/root/reference/primary/src/metrics.rs:51-485)."""
+
+from __future__ import annotations
+
+from ..metrics import Registry
+
+
+class PrimaryMetrics:
+    def __init__(self, registry: Registry):
+        self.headers_processed = registry.counter(
+            "primary_headers_processed", "Headers accepted by the core"
+        )
+        self.headers_suspended = registry.counter(
+            "primary_headers_suspended", "Headers parked awaiting parents/payload"
+        )
+        self.votes_processed = registry.counter(
+            "primary_votes_processed", "Votes aggregated by the core"
+        )
+        self.certificates_processed = registry.counter(
+            "primary_certificates_processed", "Certificates accepted by the core"
+        )
+        self.certificates_created = registry.counter(
+            "primary_certificates_created", "Certificates assembled from our own headers"
+        )
+        self.certificates_suspended = registry.counter(
+            "primary_certificates_suspended", "Certificates parked awaiting ancestors"
+        )
+        self.current_round = registry.gauge(
+            "primary_current_round", "The proposer's current round"
+        )
+        self.proposed_headers = registry.counter(
+            "primary_proposed_headers", "Headers proposed by this authority"
+        )
+        self.gc_round = registry.gauge(
+            "primary_gc_round", "Last garbage-collected consensus round"
+        )
+        self.pending_header_waits = registry.gauge(
+            "primary_pending_header_waits", "Headers pending in the header waiter"
+        )
+        self.pending_certificate_waits = registry.gauge(
+            "primary_pending_certificate_waits",
+            "Certificates pending in the certificate waiter",
+        )
+        self.sync_batch_requests = registry.counter(
+            "primary_sync_batch_requests", "Synchronize commands sent to own workers"
+        )
+        self.sync_parent_requests = registry.counter(
+            "primary_sync_parent_requests", "Parent-certificate fetches sent to peers"
+        )
+        self.votes_sent = registry.counter(
+            "primary_votes_sent", "Votes sent to header authors"
+        )

@@ -1,0 +1,30 @@
+"""The PayloadReceiver: records availability of other authorities' batches.
+
+Reference: /root/reference/primary/src/payload_receiver.rs:17-41 — our workers
+report (digest, worker_id) for every peer batch they store; the token in the
+payload store is what `Synchronizer.missing_payload` checks when voting on
+headers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..channels import Channel
+from ..stores import PayloadStore
+
+
+class PayloadReceiver:
+    def __init__(self, payload_store: PayloadStore, rx_workers: Channel):
+        self.payload_store = payload_store
+        self.rx_workers = rx_workers
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self.run())
+        return self._task
+
+    async def run(self) -> None:
+        while True:
+            digest, worker_id = await self.rx_workers.recv()
+            self.payload_store.write(digest, worker_id)

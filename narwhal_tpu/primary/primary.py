@@ -1,0 +1,247 @@
+"""Primary assembly: channels, RPC routing, and actor spawning.
+
+Reference: /root/reference/primary/src/primary.rs:71-470 — creates the metered
+channels, binds the primary network address with the PrimaryToPrimary and
+WorkerToPrimary services, and spawns Core, Proposer, HeaderWaiter,
+CertificateWaiter, PayloadReceiver, Helper (mounted as RPC handlers here) and
+StateHandler. Consensus channels (tx_new_certificates in, rx_committed
+certificates back) are handed in by the node assembly, like the reference's
+spawn signature.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel, Watch
+from ..config import Committee, Parameters, WorkerCache
+from ..crypto import SignatureService
+from ..messages import (
+    CertificatesBatchRequest,
+    CertificatesRangeRequest,
+    CertificateMsg,
+    HeaderMsg,
+    OthersBatchMsg,
+    OurBatchMsg,
+    PayloadAvailabilityRequest,
+    ReconfigureMsg,
+    VoteMsg,
+)
+from ..metrics import Registry
+from ..network import NetworkClient, RpcServer
+from ..stores import NodeStorage
+from ..types import Certificate, PublicKey, ReconfigureNotification
+from .certificate_waiter import CertificateWaiter
+from .core import Core
+from .header_waiter import HeaderWaiter
+from .helper import Helper
+from .metrics import PrimaryMetrics
+from .payload_receiver import PayloadReceiver
+from .proposer import NetworkModel, Proposer
+from .state_handler import StateHandler
+from .synchronizer import Synchronizer
+
+logger = logging.getLogger("narwhal.primary")
+
+
+class Primary:
+    def __init__(
+        self,
+        name: PublicKey,
+        signature_service: SignatureService,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        parameters: Parameters,
+        storage: NodeStorage,
+        tx_new_certificates: Channel,  # -> consensus
+        rx_committed_certificates: Channel,  # <- consensus
+        network_model: NetworkModel = NetworkModel.PARTIALLY_SYNCHRONOUS,
+        registry: Registry | None = None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.parameters = parameters
+        self.storage = storage
+        self.registry = registry or Registry()
+        self.metrics = PrimaryMetrics(self.registry)
+
+        self.network = NetworkClient()
+        self.server = RpcServer(parameters.max_concurrent_requests)
+        self._tasks: list[asyncio.Task] = []
+
+        # Channels (primary.rs:104-151).
+        self.tx_primary_messages = Channel(1_000)
+        self.tx_headers_loopback = Channel(1_000)
+        self.tx_certificates_loopback = Channel(1_000)
+        self.tx_sync_headers = Channel(1_000)  # SyncBatches | SyncParents
+        self.tx_sync_certificates = Channel(1_000)  # suspended certificates
+        self.tx_headers = Channel(1_000)  # proposer -> core
+        self.tx_parents = Channel(1_000)  # core -> proposer
+        self.tx_our_digests = Channel(10_000)  # workers -> proposer
+        self.tx_others_digests = Channel(10_000)  # workers -> payload receiver
+        self.tx_state_handler = Channel(100)
+        self.tx_new_certificates = tx_new_certificates
+        self.rx_committed_certificates = rx_committed_certificates
+
+        # Watches.
+        self.tx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
+        self.tx_consensus_round_updates: Watch = Watch(0)
+
+        genesis_digests = frozenset(
+            c.digest for c in Certificate.genesis(committee)
+        )
+        self.synchronizer = Synchronizer(
+            name,
+            storage.certificate_store,
+            storage.payload_store,
+            self.tx_sync_headers,
+            genesis_digests,
+        )
+        self.helper = Helper(
+            committee, storage.certificate_store, storage.payload_store
+        )
+        self.core = Core(
+            name,
+            committee,
+            worker_cache,
+            storage.header_store,
+            storage.certificate_store,
+            storage.vote_digest_store,
+            self.synchronizer,
+            signature_service,
+            self.network,
+            self.tx_primary_messages,
+            self.tx_headers_loopback,
+            self.tx_certificates_loopback,
+            self.tx_headers,
+            self.tx_new_certificates,
+            self.tx_parents,
+            self.tx_consensus_round_updates,
+            parameters.gc_depth,
+            self.tx_reconfigure,
+            self.metrics,
+        )
+        self.core.tx_certificate_waiter = self.tx_sync_certificates
+        self.proposer = Proposer(
+            name,
+            committee,
+            signature_service,
+            parameters.header_size,
+            parameters.max_header_delay,
+            network_model,
+            self.tx_parents,
+            self.tx_our_digests,
+            self.tx_headers,
+            self.tx_reconfigure,
+            self.metrics,
+        )
+        self.header_waiter = HeaderWaiter(
+            name,
+            committee,
+            worker_cache,
+            storage.certificate_store,
+            storage.payload_store,
+            parameters,
+            self.network,
+            self.tx_sync_headers,
+            self.tx_headers_loopback,
+            self.tx_primary_messages,
+            self.tx_consensus_round_updates,
+            self.tx_reconfigure,
+            self.metrics,
+        )
+        self.certificate_waiter = CertificateWaiter(
+            storage.certificate_store,
+            genesis_digests,
+            self.tx_sync_certificates,
+            self.tx_certificates_loopback,
+            self.tx_consensus_round_updates,
+            self.tx_reconfigure,
+            parameters.gc_depth,
+            self.metrics,
+        )
+        self.payload_receiver = PayloadReceiver(
+            storage.payload_store, self.tx_others_digests
+        )
+        self.state_handler = StateHandler(
+            name,
+            committee,
+            worker_cache,
+            self.network,
+            self.rx_committed_certificates,
+            self.tx_state_handler,
+            self.tx_consensus_round_updates,
+            self.tx_reconfigure,
+            self.metrics,
+        )
+
+    async def spawn(self) -> None:
+        address = self.committee.primary_address(self.name)
+        host, port = address.rsplit(":", 1)
+        bound = await self.server.start(host, int(port))
+        self.address = f"{host}:{bound}"
+
+        # PrimaryToPrimary plane.
+        self.server.route(HeaderMsg, self._on_header)
+        self.server.route(VoteMsg, self._on_vote)
+        self.server.route(CertificateMsg, self._on_certificate)
+        self.server.route(CertificatesBatchRequest, self.helper.on_certificates_batch)
+        self.server.route(CertificatesRangeRequest, self.helper.on_certificates_range)
+        self.server.route(
+            PayloadAvailabilityRequest, self.helper.on_payload_availability
+        )
+        # WorkerToPrimary plane.
+        self.server.route(OurBatchMsg, self._on_our_batch)
+        self.server.route(OthersBatchMsg, self._on_others_batch)
+        self.server.route(ReconfigureMsg, self._on_reconfigure)
+
+        self._tasks = [
+            self.core.spawn(),
+            self.proposer.spawn(),
+            self.header_waiter.spawn(),
+            self.certificate_waiter.spawn(),
+            self.payload_receiver.spawn(),
+            self.state_handler.spawn(),
+        ]
+        # Benchmark-parsed boot line (primary.rs:442-450).
+        logger.info(
+            "Primary %s successfully booted on %s", self.name.hex()[:16], self.address
+        )
+
+    # -- handlers ----------------------------------------------------------
+    async def _on_header(self, msg: HeaderMsg, peer: str):
+        await self.tx_primary_messages.send(msg.header)
+        return None
+
+    async def _on_vote(self, msg: VoteMsg, peer: str):
+        await self.tx_primary_messages.send(msg.vote)
+        return None
+
+    async def _on_certificate(self, msg: CertificateMsg, peer: str):
+        await self.tx_primary_messages.send(msg.certificate)
+        return None
+
+    async def _on_our_batch(self, msg: OurBatchMsg, peer: str):
+        await self.tx_our_digests.send((msg.digest, msg.worker_id))
+        return None
+
+    async def _on_others_batch(self, msg: OthersBatchMsg, peer: str):
+        await self.tx_others_digests.send((msg.digest, msg.worker_id))
+        return None
+
+    async def _on_reconfigure(self, msg: ReconfigureMsg, peer: str):
+        await self.tx_state_handler.send(
+            ReconfigureNotification(msg.kind, msg.committee())
+        )
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def shutdown(self) -> None:
+        self.tx_reconfigure.send(ReconfigureNotification("shutdown"))
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.server.stop()
+        self.network.close()

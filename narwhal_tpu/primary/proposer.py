@@ -1,0 +1,185 @@
+"""The Proposer: advances rounds and builds signed headers.
+
+Reference: /root/reference/primary/src/proposer.rs:26-338. A new header needs a
+quorum of round r-1 parent certificates (delivered as complete sets by the
+core) plus either `header_size` bytes of batch digests or the
+`max_header_delay` timer. Under partial synchrony, even rounds wait for the
+leader's certificate and odd rounds for evidence that a quorum voted on the
+leader (update_leader / enough_votes / ready, proposer.rs:131-217) so the
+whole committee advances in lock-step with the leader when the network is
+timely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import time
+
+from ..channels import Channel, Subscriber, Watch
+from ..config import Committee
+from ..crypto import SignatureService
+from ..types import Certificate, Digest, Header, PublicKey, Round, WorkerId
+
+logger = logging.getLogger("narwhal.primary")
+
+
+class NetworkModel(enum.Enum):
+    """(/root/reference/node/src/lib.rs:198-222): external consensus runs the
+    DAG asynchronously; Bullshark assumes partial synchrony."""
+
+    ASYNCHRONOUS = "asynchronous"
+    PARTIALLY_SYNCHRONOUS = "partially_synchronous"
+
+
+class Proposer:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        signature_service: SignatureService,
+        header_size: int,
+        max_header_delay: float,
+        network_model: NetworkModel,
+        rx_core: Channel,  # (parent certificates, round, epoch) from the core
+        rx_workers: Channel,  # (batch digest, worker id) from our workers
+        tx_core: Channel,  # our new headers to the core
+        rx_reconfigure: Watch,
+        metrics=None,
+    ):
+        self.name = name
+        self.committee = committee
+        self.signature_service = signature_service
+        self.header_size = header_size
+        self.max_header_delay = max_header_delay
+        self.network_model = network_model
+        self.rx_core = rx_core
+        self.rx_workers = rx_workers
+        self.tx_core = tx_core
+        self.rx_reconfigure = Subscriber(rx_reconfigure)
+        self.metrics = metrics
+
+        self.round: Round = 0
+        self.last_parents: list[Certificate] = Certificate.genesis(committee)
+        self.last_leader: Certificate | None = None
+        self.digests: list[tuple[Digest, WorkerId]] = []
+        self.payload_size = 0
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self.run())
+        return self._task
+
+    # -- leader gating (proposer.rs:131-217) ------------------------------
+    def _update_leader(self) -> bool:
+        """Even round: did we receive the current leader's certificate among
+        the parents?"""
+        leader = self.committee.leader(self.round)
+        self.last_leader = next(
+            (c for c in self.last_parents if c.origin == leader), None
+        )
+        return self.last_leader is not None
+
+    def _enough_votes(self) -> bool:
+        """Odd round: does the parent set prove the leader will (or cannot)
+        get f+1 support at the even round below?"""
+        if self.last_leader is None:
+            return True
+        leader_digest = self.last_leader.digest
+        votes_for_leader = 0
+        no_votes = 0
+        for certificate in self.last_parents:
+            stake = self.committee.stake(certificate.origin)
+            if leader_digest in certificate.header.parents:
+                votes_for_leader += stake
+            else:
+                no_votes += stake
+        return (
+            votes_for_leader >= self.committee.validity_threshold()
+            or no_votes >= self.committee.quorum_threshold()
+        )
+
+    def _ready(self) -> bool:
+        if self.network_model is NetworkModel.ASYNCHRONOUS:
+            return True
+        if self.round % 2 == 0:
+            return self._update_leader()
+        return self._enough_votes()
+
+    # -- header construction ----------------------------------------------
+    async def _make_header(self) -> None:
+        header = Header.build(
+            self.name,
+            self.round,
+            self.committee.epoch,
+            dict(self.digests),
+            {c.digest for c in self.last_parents},
+            self.signature_service,
+        )
+        self.digests.clear()
+        self.payload_size = 0
+        self.last_parents = []
+        # Benchmark-parsed creation line (proposer.rs:117-121).
+        logger.info("Created B%s(%s)", header.round, header.digest.hex())
+        if self.metrics is not None:
+            self.metrics.proposed_headers.inc()
+        await self.tx_core.send(header)
+
+    async def run(self) -> None:
+        timer_deadline = time.monotonic() + self.max_header_delay
+        parents_task = asyncio.ensure_future(self.rx_core.recv())
+        digest_task = asyncio.ensure_future(self.rx_workers.recv())
+        recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
+        try:
+            while True:
+                enough_parents = bool(self.last_parents)
+                enough_digests = self.payload_size >= self.header_size
+                timer_expired = time.monotonic() >= timer_deadline
+                # The timer overrides the leader gating so the DAG cannot
+                # stall when the leader is slow or faulty (proposer.rs:219-252).
+                if (timer_expired or (enough_digests and self._ready())) and enough_parents:
+                    if timer_expired and self.network_model is NetworkModel.PARTIALLY_SYNCHRONOUS:
+                        logger.debug("Timer expired for round %s", self.round)
+                    self.round += 1
+                    if self.metrics is not None:
+                        self.metrics.current_round.set(self.round)
+                    logger.debug("Dag moved to round %s", self.round)
+                    await self._make_header()
+                    timer_deadline = time.monotonic() + self.max_header_delay
+
+                timeout = max(0.0, timer_deadline - time.monotonic())
+                done, _ = await asyncio.wait(
+                    {parents_task, digest_task, recon_task},
+                    timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if recon_task in done:
+                    note = recon_task.result()
+                    if note.kind == "shutdown":
+                        return
+                    if note.committee is not None:
+                        self.committee = note.committee
+                        self.round = 0
+                        self.last_parents = Certificate.genesis(self.committee)
+                        self.digests.clear()
+                        self.payload_size = 0
+                        logger.info("Proposer reset for epoch %s", self.committee.epoch)
+                    recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
+                if parents_task in done:
+                    parents, round_, epoch = parents_task.result()
+                    parents_task = asyncio.ensure_future(self.rx_core.recv())
+                    if epoch == self.committee.epoch and round_ >= self.round:
+                        # Jump to the parents' round: propose on top of them
+                        # (proposer.rs:254-282).
+                        self.round = round_
+                        self.last_parents = parents
+                if digest_task in done:
+                    digest, worker_id = digest_task.result()
+                    digest_task = asyncio.ensure_future(self.rx_workers.recv())
+                    self.digests.append((digest, worker_id))
+                    self.payload_size += len(digest)
+        finally:
+            parents_task.cancel()
+            digest_task.cancel()
+            recon_task.cancel()

@@ -1,0 +1,99 @@
+"""Pure dependency-check helper used by the core.
+
+Reference: /root/reference/primary/src/synchronizer.rs:22-178 —
+`missing_payload` checks the payload store and queues a SyncBatches command for
+anything absent; `get_parents` reads parent certificates from the store and
+queues SyncParents when incomplete; `deliver_certificate` checks a
+certificate's ancestry is complete.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..channels import Channel
+from ..stores import CertificateStore, PayloadStore
+from ..types import Certificate, Digest, Header, PublicKey, WorkerId
+
+
+@dataclass
+class SyncBatches:
+    """Ask own workers to fetch `missing` batches, then replay `header`
+    (WaiterMessage::SyncBatches)."""
+
+    missing: dict[Digest, WorkerId]
+    header: Header
+
+
+@dataclass
+class SyncParents:
+    """Fetch `missing` parent certificates from `header.author`'s primary, then
+    replay `header` (WaiterMessage::SyncParents)."""
+
+    missing: list[Digest]
+    header: Header
+
+
+class Synchronizer:
+    def __init__(
+        self,
+        name: PublicKey,
+        certificate_store: CertificateStore,
+        payload_store: PayloadStore,
+        tx_header_waiter: Channel,
+        genesis_digests: frozenset[Digest],
+    ):
+        self.name = name
+        self.certificate_store = certificate_store
+        self.payload_store = payload_store
+        self.tx_header_waiter = tx_header_waiter
+        self.genesis_digests = genesis_digests
+
+    def update_genesis(self, committee) -> None:
+        """Genesis digests embed the epoch; recompute them on reconfiguration
+        or round-1 headers of the new epoch would suspend forever."""
+        self.genesis_digests = frozenset(
+            c.digest for c in Certificate.genesis(committee)
+        )
+
+    async def missing_payload(self, header: Header) -> bool:
+        """True if some batch of the header isn't locally available yet; queues
+        the repair (synchronizer.rs:60-113). Our own headers never miss: we
+        created them from digests our workers reported."""
+        if header.author == self.name:
+            return False
+        missing = {
+            digest: worker_id
+            for digest, worker_id in header.payload.items()
+            if not self.payload_store.contains(digest, worker_id)
+        }
+        if missing:
+            await self.tx_header_waiter.send(SyncBatches(missing, header))
+            return True
+        return False
+
+    async def get_parents(self, header: Header) -> list[Certificate] | None:
+        """The parent certificates, or None (repair queued) if any is missing
+        (synchronizer.rs:115-144). Genesis digests satisfy round-1 headers."""
+        parents: list[Certificate] = []
+        missing: list[Digest] = []
+        for digest in header.parents:
+            if digest in self.genesis_digests:
+                continue
+            cert = self.certificate_store.read(digest)
+            if cert is None:
+                missing.append(digest)
+            else:
+                parents.append(cert)
+        if missing:
+            await self.tx_header_waiter.send(SyncParents(missing, header))
+            return None
+        return parents
+
+    def deliver_certificate(self, certificate: Certificate) -> bool:
+        """True iff the certificate's direct ancestry is locally complete
+        (synchronizer.rs:146-178)."""
+        return all(
+            digest in self.genesis_digests or self.certificate_store.contains(digest)
+            for digest in certificate.header.parents
+        )

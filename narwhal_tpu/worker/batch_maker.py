@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 
 from ..channels import Channel, Subscriber, Watch
 from ..types import Batch
@@ -45,22 +46,27 @@ class BatchMaker:
         return asyncio.ensure_future(self.run())
 
     async def run(self) -> None:
+        # Fixed deadline, NOT an idle timeout: the timer runs from the last
+        # seal, so a steady sub-batch-size trickle still seals every
+        # max_batch_delay (batch_maker.rs:77-122 uses an interval timer).
+        deadline = time.monotonic() + self.max_batch_delay
         while True:
+            timeout = max(0.0, deadline - time.monotonic())
             try:
-                tx = await asyncio.wait_for(
-                    self.rx_transaction.recv(), timeout=self.max_batch_delay
-                )
+                tx = await asyncio.wait_for(self.rx_transaction.recv(), timeout=timeout)
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
                 self._pending.append(tx)
                 self._pending_bytes += len(tx)
                 if self._pending_bytes >= self.batch_size:
                     await self._seal()
+                    deadline = time.monotonic() + self.max_batch_delay
             except asyncio.TimeoutError:
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
                 if self._pending:
                     await self._seal()
+                deadline = time.monotonic() + self.max_batch_delay
 
     async def _seal(self) -> None:
         batch = Batch(tuple(self._pending))

@@ -47,8 +47,12 @@ class QuorumWaiter:
     async def run(self) -> None:
         while True:
             batch: Batch = await self.rx_message.recv()
-            if self.rx_reconfigure.peek().kind == "shutdown":
+            note = self.rx_reconfigure.peek()
+            if note.kind == "shutdown":
                 return
+            if note.committee is not None and note.committee is not self.committee:
+                # Adopt the reconfigured committee before counting stake.
+                self.committee = note.committee
             serialized = batch.to_bytes()
             others = self.worker_cache.others_workers(self.name, self.worker_id)
             msg = WorkerBatchMsg(serialized)
@@ -60,8 +64,7 @@ class QuorumWaiter:
             total = self.committee.stake(self.name)  # our own vote
             threshold = self.committee.quorum_threshold()
             pending = {
-                asyncio.ensure_future(self._wait(stake, h)): stake
-                for stake, h in handles
+                asyncio.ensure_future(self._wait(stake, h)) for stake, h in handles
             }
             try:
                 while total < threshold and pending:
@@ -70,7 +73,7 @@ class QuorumWaiter:
                     )
                     for t in done:
                         total += t.result()
-                        pending.pop(t, None)
+                        pending.discard(t)
             finally:
                 # Remaining reliable sends keep retrying in the background
                 # (the reference lets its CancelOnDrop handles continue until

@@ -64,8 +64,11 @@ class WorkerSynchronizer:
                 done, _ = await asyncio.wait(
                     {timer, cmd}, return_when=asyncio.FIRST_COMPLETED
                 )
-                if self.rx_reconfigure.peek().kind == "shutdown":
+                note = self.rx_reconfigure.peek()
+                if note.kind == "shutdown":
                     return
+                if note.committee is not None and note.committee is not self.committee:
+                    self.committee = note.committee
                 if cmd in done:
                     msg = cmd.result()
                     cmd = asyncio.ensure_future(self.rx_command.recv())
