@@ -1,0 +1,150 @@
+"""Role binaries: the CLI entry point.
+
+Reference: /root/reference/node/src/main.rs:39-153 — subcommands
+`generate_keys`, `run primary [--consensus-disabled]`, `run worker --id N`,
+plus `benchmark_client`; telemetry goes to stdout in the RFC-3339-ish format
+the benchmark harness parses (:155-200); a prometheus endpoint serves each
+role's registry (:279-285).
+
+Usage:
+  python -m narwhal_tpu generate_keys --filename key.json
+  python -m narwhal_tpu run --keys key.json --committee committee.json \
+      --workers workers.json --parameters parameters.json --store db primary
+  python -m narwhal_tpu run ... worker --id 0
+  python -m narwhal_tpu benchmark_client --target host:port --rate 1000 --size 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+
+from .benchmark_client import BenchmarkClient
+from .config import Committee, Parameters, WorkerCache
+from .crypto import KeyPair
+from .metrics import serve_metrics
+from .node import PrimaryNode, WorkerNode
+from .stores import NodeStorage
+
+
+def _setup_logging(verbosity: int) -> None:
+    level = [logging.WARNING, logging.INFO, logging.DEBUG][min(verbosity, 2)]
+    # The benchmark harness parses "<RFC3339 UTC> <LEVEL> <msg>" lines.
+    logging.basicConfig(
+        stream=sys.stdout,
+        level=level,
+        format="%(asctime)s.%(msecs)03dZ %(levelname)s %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+    )
+
+
+def _load_keypair(path: str) -> KeyPair:
+    with open(path) as f:
+        data = json.load(f)
+    return KeyPair.from_seed(bytes.fromhex(data["seed"]))
+
+
+def cmd_generate_keys(args) -> None:
+    import secrets
+
+    seed = secrets.token_bytes(32)
+    kp = KeyPair.from_seed(seed)
+    with open(args.filename, "w") as f:
+        json.dump({"name": kp.public.hex(), "seed": seed.hex()}, f, indent=2)
+    print(kp.public.hex())
+
+
+async def _run_node(args) -> None:
+    keypair = _load_keypair(args.keys)
+    committee = Committee.import_(args.committee)
+    worker_cache = WorkerCache.import_(args.workers)
+    parameters = (
+        Parameters.import_(args.parameters) if args.parameters else Parameters()
+    )
+
+    if args.role == "primary":
+        storage = NodeStorage(f"{args.store}-primary" if args.store else None)
+        node = PrimaryNode(
+            keypair,
+            committee,
+            worker_cache,
+            parameters,
+            storage,
+            internal_consensus=not args.consensus_disabled,
+        )
+        await node.spawn()
+        registry = node.registry
+    else:
+        storage = NodeStorage(
+            f"{args.store}-worker-{args.id}" if args.store else None
+        )
+        node = WorkerNode(
+            keypair.public,
+            args.id,
+            committee,
+            worker_cache,
+            parameters,
+            storage,
+            benchmark=True,
+        )
+        await node.spawn()
+        registry = node.registry
+
+    host, port = parameters.prometheus_address.rsplit(":", 1)
+    await serve_metrics(registry, host, int(port))
+    await asyncio.Event().wait()  # run forever
+
+
+async def _run_benchmark_client(args) -> None:
+    client = BenchmarkClient(
+        args.target, size=args.size, rate=args.rate, nodes=tuple(args.nodes)
+    )
+    await client.wait_for_nodes()
+    client.spawn()
+    await asyncio.Event().wait()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="narwhal_tpu")
+    parser.add_argument("-v", "--verbose", action="count", default=1)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    g = sub.add_parser("generate_keys")
+    g.add_argument("--filename", required=True)
+
+    r = sub.add_parser("run")
+    r.add_argument("--keys", required=True)
+    r.add_argument("--committee", required=True)
+    r.add_argument("--workers", required=True)
+    r.add_argument("--parameters", default=None)
+    r.add_argument("--store", default=None)
+    rsub = r.add_subparsers(dest="role", required=True)
+    p = rsub.add_parser("primary")
+    p.add_argument(
+        "--consensus-disabled", action="store_true",
+        help="external consensus: expose the Dag API instead of Bullshark",
+    )
+    w = rsub.add_parser("worker")
+    w.add_argument("--id", type=int, required=True)
+
+    b = sub.add_parser("benchmark_client")
+    b.add_argument("--target", required=True)
+    b.add_argument("--size", type=int, default=512)
+    b.add_argument("--rate", type=int, default=1_000)
+    b.add_argument("--nodes", nargs="*", default=[])
+
+    args = parser.parse_args(argv)
+    _setup_logging(args.verbose)
+    if args.command == "generate_keys":
+        cmd_generate_keys(args)
+    elif args.command == "run":
+        asyncio.run(_run_node(args))
+    elif args.command == "benchmark_client":
+        asyncio.run(_run_benchmark_client(args))
+
+
+if __name__ == "__main__":
+    main()

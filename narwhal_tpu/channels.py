@@ -159,14 +159,23 @@ class BoundedFuturesOrdered:
     def __init__(self, capacity: int):
         self._capacity = capacity
         self._queue: asyncio.Queue[asyncio.Task] = asyncio.Queue(maxsize=capacity)
+        self._live: set[asyncio.Task] = set()
 
     async def push(self, coro: Awaitable) -> None:
         task = asyncio.ensure_future(coro)
+        self._live.add(task)
+        task.add_done_callback(self._live.discard)
         await self._queue.put(task)
 
     async def next(self):
         task = await self._queue.get()
         return await task
+
+    def cancel_all(self) -> None:
+        """Cancel every pushed future that has not completed yet; the pool
+        owner must call this on teardown or in-flight work outlives it."""
+        for task in list(self._live):
+            task.cancel()
 
     def qsize(self) -> int:
         return self._queue.qsize()

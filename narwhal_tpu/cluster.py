@@ -1,0 +1,176 @@
+"""In-process multi-node cluster for integration tests and local benchmarks.
+
+Reference: /root/reference/test_utils/src/cluster.rs:31-793 — a whole
+committee in one process: every authority runs a real primary (with consensus
+and executor) plus workers as asyncio tasks over real loopback TCP, with
+per-node registries; progress is asserted by scraping metrics
+(assert_progress, cluster.rs:210-269).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import replace
+
+from .config import (
+    Authority,
+    Committee,
+    Parameters,
+    WorkerCache,
+    WorkerInfo,
+    get_available_port,
+)
+from .fixtures import CommitteeFixture
+from .metrics import Registry
+from .node import PrimaryNode, SimpleExecutionState, WorkerNode
+from .stores import NodeStorage
+from .types import PublicKey
+
+logger = logging.getLogger("narwhal.cluster")
+
+
+class AuthorityDetails:
+    """Handles for one authority's roles (cluster.rs AuthorityDetails)."""
+
+    def __init__(self, cluster: "Cluster", index: int, name: PublicKey):
+        self.cluster = cluster
+        self.index = index
+        self.name = name
+        self.primary: PrimaryNode | None = None
+        self.workers: dict[int, WorkerNode] = {}
+        self.store_path: str | None = None
+
+    @property
+    def registry(self) -> Registry | None:
+        return self.primary.registry if self.primary else None
+
+    def metric(self, name: str) -> float:
+        """Scrape one gauge/counter from the primary's registry
+        (cluster.rs:315 PrimaryNodeDetails::metric)."""
+        return self.primary.registry.value(name)
+
+    def worker_transactions_address(self, worker_id: int = 0) -> str:
+        return self.cluster.worker_cache.worker(self.name, worker_id).transactions
+
+    async def stop(self) -> None:
+        if self.primary is not None:
+            await self.primary.shutdown()
+            self.primary = None
+        for w in self.workers.values():
+            await w.shutdown()
+        self.workers.clear()
+
+
+class Cluster:
+    def __init__(
+        self,
+        size: int = 4,
+        workers: int = 1,
+        parameters: Parameters | None = None,
+        internal_consensus: bool = True,
+        benchmark: bool = False,
+        store_base: str | None = None,
+    ):
+        self.fixture = CommitteeFixture(size=size, workers=workers)
+        self.parameters = parameters or replace(
+            self.fixture.parameters, max_header_delay=0.05, max_batch_delay=0.05
+        )
+        self.internal_consensus = internal_consensus
+        self.benchmark = benchmark
+        self.store_base = store_base
+        # Pre-assign real ports so no early broadcast targets a placeholder.
+        committee = self.fixture.committee
+        for pk, auth in committee.authorities.items():
+            committee.authorities[pk] = replace(
+                auth, primary_address=f"127.0.0.1:{get_available_port()}"
+            )
+        for pk, ws in self.fixture.worker_cache.workers.items():
+            for wid, info in ws.items():
+                ws[wid] = WorkerInfo(
+                    name=info.name,
+                    transactions=f"127.0.0.1:{get_available_port()}",
+                    worker_address=f"127.0.0.1:{get_available_port()}",
+                )
+        self.committee: Committee = committee
+        self.worker_cache: WorkerCache = self.fixture.worker_cache
+        self.authorities: list[AuthorityDetails] = [
+            AuthorityDetails(self, i, a.public)
+            for i, a in enumerate(self.fixture.authorities)
+        ]
+
+    def _store(self, index: int, role: str) -> NodeStorage:
+        if self.store_base is None:
+            return NodeStorage(None)
+        return NodeStorage(f"{self.store_base}/node-{index}-{role}")
+
+    async def start_node(self, index: int) -> AuthorityDetails:
+        """(cluster.rs start_node): boot one authority's primary + workers."""
+        details = self.authorities[index]
+        fixture_auth = self.fixture.authorities[index]
+        storage = self._store(index, "primary")
+        details.primary = PrimaryNode(
+            fixture_auth.keypair,
+            self.committee,
+            self.worker_cache,
+            self.parameters,
+            storage,
+            internal_consensus=self.internal_consensus,
+        )
+        await details.primary.spawn()
+        for wid in range(self.fixture.workers_per_authority):
+            wn = WorkerNode(
+                fixture_auth.public,
+                wid,
+                self.committee,
+                self.worker_cache,
+                self.parameters,
+                self._store(index, f"worker-{wid}"),
+                benchmark=self.benchmark,
+            )
+            await wn.spawn()
+            details.workers[wid] = wn
+        return details
+
+    async def start(self, nodes: int | None = None) -> None:
+        n = nodes if nodes is not None else len(self.authorities)
+        for i in range(n):
+            await self.start_node(i)
+
+    async def stop_node(self, index: int) -> None:
+        await self.authorities[index].stop()
+
+    async def restart_node(self, index: int) -> AuthorityDetails:
+        await self.stop_node(index)
+        return await self.start_node(index)
+
+    async def shutdown(self) -> None:
+        for a in self.authorities:
+            await a.stop()
+
+    async def assert_progress(
+        self,
+        expected_nodes: int | None = None,
+        commit_threshold: int = 1,
+        timeout: float = 30.0,
+    ) -> dict[PublicKey, float]:
+        """Wait until every running node's last committed round reaches
+        commit_threshold (cluster.rs assert_progress via metric scraping)."""
+        expected = expected_nodes or sum(
+            1 for a in self.authorities if a.primary is not None
+        )
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            rounds = {
+                a.name: a.metric("consensus_last_committed_round")
+                for a in self.authorities
+                if a.primary is not None
+            }
+            ok = [r for r in rounds.values() if r >= commit_threshold]
+            if len(ok) >= expected:
+                return rounds
+            if asyncio.get_event_loop().time() > deadline:
+                raise AssertionError(
+                    f"no progress: committed rounds {rounds} < {commit_threshold}"
+                )
+            await asyncio.sleep(0.1)

@@ -1,0 +1,22 @@
+"""Consensus metrics (/root/reference/consensus/src/metrics.rs:13-49)."""
+
+from __future__ import annotations
+
+from ..metrics import Registry
+
+
+class ConsensusMetrics:
+    def __init__(self, registry: Registry):
+        self.last_committed_round = registry.gauge(
+            "consensus_last_committed_round", "The last committed leader round"
+        )
+        self.committed_certificates = registry.counter(
+            "consensus_committed_certificates", "Certificates sequenced by consensus"
+        )
+        self.consensus_dag_size = registry.gauge(
+            "consensus_dag_size", "Certificates resident in the consensus DAG"
+        )
+        self.recovered_consensus_state = registry.counter(
+            "consensus_recovered_consensus_state",
+            "Times the consensus state was rebuilt from the store at startup",
+        )

@@ -1,0 +1,95 @@
+"""The executor: pulls ordered certificates and applies them to the app.
+
+Reference crate: /root/reference/executor/ (see SURVEY §2.10). Assembly
+mirrors Executor::spawn (executor/src/lib.rs:89-145): a Subscriber staging
+payloads in consensus order feeding an ExecutorCore that applies transactions
+exactly-once over crashes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..channels import Channel
+from ..config import WorkerCache
+from ..network import NetworkClient
+from ..stores import CertificateStore, ConsensusStore, NodeStorage
+from ..types import ConsensusOutput, PublicKey
+from .core import (
+    ClientExecutionError,
+    ExecutionState,
+    ExecutionStateError,
+    ExecutorCore,
+)
+from .state import ExecutionIndices
+from .subscriber import Subscriber
+
+__all__ = [
+    "ClientExecutionError",
+    "ExecutionIndices",
+    "ExecutionState",
+    "ExecutionStateError",
+    "Executor",
+    "ExecutorCore",
+    "Subscriber",
+    "get_restored_consensus_output",
+]
+
+
+async def get_restored_consensus_output(
+    consensus_store: ConsensusStore,
+    certificate_store: CertificateStore,
+    execution_state: ExecutionState,
+) -> list[ConsensusOutput]:
+    """Crash recovery (/root/reference/executor/src/lib.rs:147-185): replay
+    every sequenced certificate at or past the executor's certificate cursor."""
+    indices = await execution_state.load_execution_indices()
+    out: list[ConsensusOutput] = []
+    for index, digest in consensus_store.read_sequenced_digests_after(
+        indices.next_certificate_index
+    ):
+        certificate = certificate_store.read(digest)
+        if certificate is not None:
+            out.append(ConsensusOutput(certificate, index))
+    return out
+
+
+class Executor:
+    """Subscriber + ExecutorCore pair (executor/src/lib.rs:89-145)."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_cache: WorkerCache,
+        storage: NodeStorage,
+        execution_state: ExecutionState,
+        network: NetworkClient,
+        rx_consensus: Channel,
+        tx_output: Channel | None = None,
+    ):
+        self.tx_executor = Channel(1_000)
+        self.subscriber = Subscriber(
+            name,
+            worker_cache,
+            network,
+            storage.temp_batch_store,
+            rx_consensus,
+            self.tx_executor,
+        )
+        self.core = ExecutorCore(
+            execution_state, storage.temp_batch_store, self.tx_executor, tx_output
+        )
+        self._tasks: list[asyncio.Task] = []
+
+    async def spawn(
+        self, restored: list[ConsensusOutput] | None = None
+    ) -> list[asyncio.Task]:
+        self._tasks = [self.subscriber.spawn(), self.core.spawn()]
+        # Re-inject restored outputs ahead of live traffic (lib.rs:120-135).
+        for output in restored or []:
+            await self.subscriber.rx_consensus.send(output)
+        return self._tasks
+
+    def shutdown(self) -> None:
+        for t in self._tasks:
+            t.cancel()

@@ -1,0 +1,123 @@
+"""The execution Core: exactly-once transaction application across crashes.
+
+Reference: /root/reference/executor/src/core.rs:30-260 — for each ordered
+certificate, executes its batches transaction by transaction, skipping
+anything at or before the persisted ExecutionIndices (crash replay),
+distinguishing client errors (bad transaction: skip and advance) from node
+errors (halt), and cleaning the temp batch store per certificate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel
+from ..stores import BatchStore
+from ..types import Batch, ConsensusOutput
+from .state import ExecutionIndices
+
+logger = logging.getLogger("narwhal.executor")
+
+
+class ExecutionStateError(Exception):
+    """Node-level execution failure: halt (core.rs:86-127 node errors)."""
+
+
+class ClientExecutionError(Exception):
+    """Transaction-level failure: skip the transaction and advance."""
+
+
+class ExecutionState:
+    """The application interface (/root/reference/executor/src/lib.rs:47-78).
+
+    Implementations persist ExecutionIndices atomically with their own state
+    inside handle_consensus_transaction."""
+
+    async def handle_consensus_transaction(
+        self, output: ConsensusOutput, indices: ExecutionIndices, transaction: bytes
+    ):
+        raise NotImplementedError
+
+    async def load_execution_indices(self) -> ExecutionIndices:
+        raise NotImplementedError
+
+    def ask_consensus_write_lock(self) -> bool:
+        return False
+
+    def release_consensus_write_lock(self) -> None:
+        pass
+
+
+class ExecutorCore:
+    def __init__(
+        self,
+        execution_state: ExecutionState,
+        temp_batch_store: BatchStore,
+        rx_subscriber: Channel,  # staged ConsensusOutput
+        tx_output: Channel | None = None,  # (outcome, transaction) to the app
+    ):
+        self.execution_state = execution_state
+        self.temp_batch_store = temp_batch_store
+        self.rx_subscriber = rx_subscriber
+        self.tx_output = tx_output
+        self.execution_indices = ExecutionIndices()
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self.run())
+        return self._task
+
+    async def run(self) -> None:
+        self.execution_indices = await self.execution_state.load_execution_indices()
+        try:
+            while True:
+                output: ConsensusOutput = await self.rx_subscriber.recv()
+                await self.execute_certificate(output)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # Node-level failure (core.rs:86-127): execution halts while the
+            # rest of the node keeps running — make that loudly visible.
+            logger.critical("execution halted on node error", exc_info=True)
+            raise
+
+    async def execute_certificate(self, output: ConsensusOutput) -> None:
+        """(core.rs:129-259)."""
+        certificate = output.certificate
+        payload = list(certificate.header.payload.items())
+        total_batches = len(payload)
+        for batch_index, (digest, _worker_id) in enumerate(payload):
+            if batch_index < self.execution_indices.next_batch_index:
+                continue  # crash replay: batch already fully executed
+            raw = self.temp_batch_store.read(digest)
+            if raw is None:
+                raise ExecutionStateError(
+                    f"staged batch {digest.hex()[:16]} missing from temp store"
+                )
+            batch = Batch.from_bytes(raw)
+            await self._execute_batch(output, batch, total_batches)
+        if total_batches == 0:
+            # Empty certificate: still advances the certificate cursor.
+            self.execution_indices = ExecutionIndices(
+                next_certificate_index=self.execution_indices.next_certificate_index + 1
+            )
+        self.temp_batch_store.delete_all(d for d, _ in payload)
+
+    async def _execute_batch(
+        self, output: ConsensusOutput, batch: Batch, total_batches: int
+    ) -> None:
+        total_transactions = len(batch.transactions)
+        for tx_index, transaction in enumerate(batch.transactions):
+            if tx_index < self.execution_indices.next_transaction_index:
+                continue  # crash replay
+            next_indices = self.execution_indices.next(total_batches, total_transactions)
+            try:
+                result = await self.execution_state.handle_consensus_transaction(
+                    output, next_indices, transaction
+                )
+                if self.tx_output is not None:
+                    await self.tx_output.send((result, transaction))
+            except ClientExecutionError as e:
+                logger.debug("skipping bad transaction: %s", e)
+            self.execution_indices = next_indices

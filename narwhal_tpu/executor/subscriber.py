@@ -1,0 +1,98 @@
+"""The Subscriber: downloads payloads of ordered certificates, in order.
+
+Reference: /root/reference/executor/src/subscriber.rs:30-100 — receives
+ConsensusOutput, fetches every batch of the certificate's payload (via
+BlockCommand::GetBlock to the BlockWaiter in the reference; here by asking our
+own workers `RequestBatch` directly over RPC) with infinite exponential
+backoff, stages the batches in the temp batch store, and forwards outputs to
+the execution core strictly in consensus order (BoundedFuturesOrdered).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import BoundedFuturesOrdered, Channel
+from ..config import WorkerCache
+from ..messages import RequestBatchMsg, RequestedBatchMsg
+from ..network import NetworkClient, RpcError
+from ..stores import BatchStore
+from ..types import Batch, ConsensusOutput, PublicKey
+
+logger = logging.getLogger("narwhal.executor")
+
+MAX_PENDING_PAYLOADS = 1_000
+
+
+class Subscriber:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_cache: WorkerCache,
+        network: NetworkClient,
+        temp_batch_store: BatchStore,
+        rx_consensus: Channel,  # ConsensusOutput from the consensus runner
+        tx_executor: Channel,  # ConsensusOutput, payload staged, to the core
+    ):
+        self.name = name
+        self.worker_cache = worker_cache
+        self.network = network
+        self.temp_batch_store = temp_batch_store
+        self.rx_consensus = rx_consensus
+        self.tx_executor = tx_executor
+        self._task: asyncio.Task | None = None
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self.run())
+        return self._task
+
+    async def _fetch_batch(self, digest: bytes, worker_id: int) -> None:
+        """Fetch one batch from our own worker with infinite exponential
+        backoff (subscriber.rs:65-72), staging it in the temp store."""
+        if self.temp_batch_store.contains(digest):
+            return
+        delay = 0.05
+        while True:
+            try:
+                info = self.worker_cache.worker(self.name, worker_id)
+                resp: RequestedBatchMsg = await self.network.request(
+                    info.worker_address, RequestBatchMsg(digest), timeout=10.0
+                )
+                batch = Batch(resp.transactions)
+                if batch.digest == digest:
+                    self.temp_batch_store.write(digest, batch.to_bytes())
+                    return
+                # Worker doesn't have it yet (empty reply) or corrupt: retry.
+            except (RpcError, OSError, KeyError) as e:
+                logger.debug("batch fetch retry for %s: %s", digest.hex()[:16], e)
+            await asyncio.sleep(delay)
+            delay = min(delay * 2, 5.0)
+
+    async def _stage(self, output: ConsensusOutput) -> ConsensusOutput:
+        payload = output.certificate.header.payload
+        if payload:
+            await asyncio.gather(
+                *(self._fetch_batch(d, w) for d, w in payload.items())
+            )
+        return output
+
+    async def run(self) -> None:
+        pending = BoundedFuturesOrdered(MAX_PENDING_PAYLOADS)
+
+        async def forward():
+            while True:
+                output = await pending.next()
+                await self.tx_executor.send(output)
+
+        forwarder = asyncio.ensure_future(forward())
+        try:
+            while True:
+                output: ConsensusOutput = await self.rx_consensus.recv()
+                await pending.push(self._stage(output))
+        finally:
+            # Cancel staged fetches too: their infinite-backoff retry loops
+            # would otherwise keep hitting workers (and writing into our
+            # store) after the node shuts down or restarts.
+            forwarder.cancel()
+            pending.cancel_all()

@@ -1,0 +1,254 @@
+"""Node assembly: wire storage, primary, consensus, executor and workers.
+
+Reference: /root/reference/node/src/lib.rs — NodeStorage::reopen :43-124,
+Node::spawn_primary :134-282 (internal_consensus=true => Bullshark + executor
+under partial synchrony; false => the external Dag service under asynchrony),
+spawn_consensus :284-370, spawn_workers :373-407; NodeRestarter
+(node/src/restarter.rs:18-) tears the node down and respawns it on committee
+change with a fresh store per epoch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from .channels import Channel
+from .config import Committee, Parameters, WorkerCache
+from .consensus import Bullshark, Consensus, Tusk
+from .consensus.metrics import ConsensusMetrics
+from .crypto import KeyPair, SignatureService
+from .executor import (
+    ExecutionIndices,
+    ExecutionState,
+    Executor,
+    get_restored_consensus_output,
+)
+from .metrics import Registry
+from .primary import NetworkModel, Primary
+from .stores import NodeStorage
+from .types import ConsensusOutput, PublicKey
+from .worker import Worker
+
+logger = logging.getLogger("narwhal.node")
+
+
+class SimpleExecutionState(ExecutionState):
+    """No-op application persisting its execution cursor in the node's store
+    (/root/reference/node/src/execution_state.rs:9-60)."""
+
+    def __init__(self, storage: NodeStorage | None = None):
+        self._cf = (
+            storage.engine.column_family("execution_indices")
+            if storage is not None
+            else None
+        )
+        self._indices = ExecutionIndices()
+
+    async def handle_consensus_transaction(self, output, indices, transaction):
+        self._indices = indices
+        if self._cf is not None:
+            self._cf.put(b"indices", indices.to_bytes())
+        return b""
+
+    async def load_execution_indices(self) -> ExecutionIndices:
+        if self._cf is not None:
+            raw = self._cf.get(b"indices")
+            if raw is not None:
+                self._indices = ExecutionIndices.from_bytes(raw)
+        return self._indices
+
+
+class PrimaryNode:
+    """One authority's primary role: Primary + Consensus + Executor
+    (Node::spawn_primary, node/src/lib.rs:134-282)."""
+
+    def __init__(
+        self,
+        keypair: KeyPair,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        parameters: Parameters,
+        storage: NodeStorage,
+        execution_state: ExecutionState | None = None,
+        internal_consensus: bool = True,
+        consensus_protocol: str = "bullshark",
+        registry: Registry | None = None,
+    ):
+        self.keypair = keypair
+        self.name: PublicKey = keypair.public
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.parameters = parameters
+        self.storage = storage
+        self.registry = registry or Registry()
+        self.internal_consensus = internal_consensus
+
+        # Channels between the three subsystems (node/src/lib.rs:150-192).
+        self.tx_new_certificates = Channel(10_000)
+        self.tx_committed_certificates = Channel(10_000)
+        self.tx_consensus_output = Channel(10_000)
+        self.tx_execution_output = Channel(10_000)
+
+        self.primary = Primary(
+            self.name,
+            SignatureService(keypair),
+            committee,
+            worker_cache,
+            parameters,
+            storage,
+            self.tx_new_certificates,
+            self.tx_committed_certificates,
+            network_model=(
+                NetworkModel.PARTIALLY_SYNCHRONOUS
+                if internal_consensus
+                else NetworkModel.ASYNCHRONOUS
+            ),
+            registry=self.registry,
+        )
+
+        self.consensus: Consensus | None = None
+        self.executor: Executor | None = None
+        self.execution_state = execution_state or SimpleExecutionState(storage)
+        if internal_consensus:
+            protocol_cls = {"bullshark": Bullshark, "tusk": Tusk}[consensus_protocol]
+            protocol = protocol_cls(
+                committee, storage.consensus_store, parameters.gc_depth
+            )
+            self.consensus = Consensus(
+                committee,
+                protocol,
+                storage.consensus_store,
+                storage.certificate_store,
+                self.tx_new_certificates,
+                self.tx_committed_certificates,
+                self.tx_consensus_output,
+                self.primary.tx_reconfigure,
+                parameters.gc_depth,
+                ConsensusMetrics(self.registry),
+            )
+            self.executor = Executor(
+                self.name,
+                worker_cache,
+                storage,
+                self.execution_state,
+                self.primary.network,
+                self.tx_consensus_output,
+                self.tx_execution_output,
+            )
+        self._tasks: list[asyncio.Task] = []
+
+    @property
+    def address(self) -> str:
+        return self.primary.address
+
+    async def spawn(self) -> None:
+        restored: list[ConsensusOutput] = []
+        if self.internal_consensus:
+            restored = await get_restored_consensus_output(
+                self.storage.consensus_store,
+                self.storage.certificate_store,
+                self.execution_state,
+            )
+            if restored:
+                logger.info("Replaying %d consensus outputs after restart", len(restored))
+        await self.primary.spawn()
+        if self.consensus is not None:
+            self._tasks.append(self.consensus.spawn())
+        if self.executor is not None:
+            self._tasks.extend(await self.executor.spawn(restored))
+        if not self.internal_consensus:
+            # The external Dag service is this channel's consumer in the
+            # reference (node/src/lib.rs:198-213); until a Dag is attached,
+            # drain it so the Core never blocks on a full channel.
+            async def drain() -> None:
+                while True:
+                    await self.tx_new_certificates.recv()
+
+            self._tasks.append(asyncio.ensure_future(drain()))
+
+    async def shutdown(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.primary.shutdown()
+        self.storage.close()
+
+
+class WorkerNode:
+    """One authority's worker role (Node::spawn_workers, lib.rs:373-407)."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_id: int,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        parameters: Parameters,
+        storage: NodeStorage,
+        registry: Registry | None = None,
+        benchmark: bool = False,
+    ):
+        self.registry = registry or Registry()
+        self.storage = storage
+        self.worker = Worker(
+            name,
+            worker_id,
+            committee,
+            worker_cache,
+            parameters,
+            storage.batch_store,
+            registry=self.registry,
+            benchmark=benchmark,
+        )
+
+    async def spawn(self) -> None:
+        await self.worker.spawn()
+
+    async def shutdown(self) -> None:
+        await self.worker.shutdown()
+        self.storage.close()
+
+
+class NodeRestarter:
+    """Tear down and respawn a primary on committee change
+    (/root/reference/node/src/restarter.rs:18-): each epoch gets a fresh
+    in-memory store unless a store factory is provided."""
+
+    def __init__(
+        self,
+        keypair: KeyPair,
+        worker_cache: WorkerCache,
+        parameters: Parameters,
+        store_factory=None,
+        execution_state_factory=None,
+    ):
+        self.keypair = keypair
+        self.worker_cache = worker_cache
+        self.parameters = parameters
+        self.store_factory = store_factory or (lambda epoch: NodeStorage(None))
+        self.execution_state_factory = execution_state_factory
+        self.node: PrimaryNode | None = None
+
+    async def start(self, committee: Committee) -> PrimaryNode:
+        storage = self.store_factory(committee.epoch)
+        execution_state = (
+            self.execution_state_factory(storage)
+            if self.execution_state_factory
+            else None
+        )
+        self.node = PrimaryNode(
+            self.keypair,
+            committee,
+            self.worker_cache,
+            self.parameters,
+            storage,
+            execution_state=execution_state,
+        )
+        await self.node.spawn()
+        return self.node
+
+    async def restart(self, new_committee: Committee) -> PrimaryNode:
+        if self.node is not None:
+            await self.node.shutdown()
+        return await self.start(new_committee)

@@ -1,0 +1,107 @@
+"""Full-system tests over the in-process Cluster, mirroring
+/root/reference/node/tests/node_smoke_test.rs,
+executor/tests/consensus_integration_tests.rs and the cluster-based
+nodes_bootstrapping/restart tests."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.cluster import Cluster
+from narwhal_tpu.messages import SubmitTransactionMsg, SubmitTransactionStreamMsg
+from narwhal_tpu.network import NetworkClient
+
+
+def test_cluster_commits_without_load(run):
+    """Four nodes, no transactions: empty headers still drive Bullshark
+    commits (leader election over empty certificates)."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1)
+        await cluster.start()
+        try:
+            rounds = await cluster.assert_progress(commit_threshold=2, timeout=30.0)
+            assert all(r >= 2 for r in rounds.values())
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=60.0)
+
+
+def test_cluster_commits_transactions_e2e(run):
+    """Client txs -> worker batches -> DAG -> Bullshark -> executor: the
+    executed transactions come out the execution output channel in the same
+    order on every node."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1)
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            target = cluster.authorities[0].worker_transactions_address(0)
+            txs = tuple(bytes([1]) * 8 + bytes([i]) for i in range(64))
+            await client.request(target, SubmitTransactionStreamMsg(txs))
+
+            async def executed(details, count):
+                out = []
+                while len(out) < count:
+                    _, tx = await asyncio.wait_for(
+                        details.primary.tx_execution_output.recv(), 30.0
+                    )
+                    out.append(tx)
+                return out
+
+            # Every node must execute all 64 txs, in an identical order.
+            results = await asyncio.gather(
+                *(executed(a, 64) for a in cluster.authorities)
+            )
+            assert all(len(r) == 64 for r in results)
+            assert results[0] == results[1] == results[2] == results[3]
+            assert set(results[0]) == set(txs)
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
+
+
+def test_cluster_survives_one_fault(run):
+    """Stop one of four nodes: the remaining 2f+1 keep committing
+    (the benchmark harness's `faults` parameter behavior)."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1)
+        await cluster.start()
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=30.0)
+            await cluster.stop_node(3)
+            before = min(
+                a.metric("consensus_last_committed_round")
+                for a in cluster.authorities
+                if a.primary is not None
+            )
+            await cluster.assert_progress(
+                expected_nodes=3, commit_threshold=int(before) + 4, timeout=30.0
+            )
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
+
+
+def test_node_restart_recovers_from_store(run, tmp_path):
+    """Restart a node with a persistent store: consensus state recovers and
+    the node resumes committing (causal_completion_tests.rs restart)."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1, store_base=str(tmp_path))
+        await cluster.start()
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=30.0)
+            await cluster.restart_node(0)
+            rounds = await cluster.assert_progress(commit_threshold=4, timeout=30.0)
+            assert rounds[cluster.authorities[0].name] >= 4
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=120.0)
