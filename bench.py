@@ -1,0 +1,90 @@
+"""Headline benchmark: consensus DAG ordering throughput, device vs host.
+
+Runs the Bullshark commit path over identical synthetic certificate streams
+through the host engine (pointer-chasing, like
+/root/reference/consensus/src/utils.rs) and the TPU engine (adjacency-tensor
+walks, narwhal_tpu/tpu/dag_kernels.py), mirroring the reference's criterion
+bench `consensus/benches/process_certificates.rs:18-80` (committee of 2f+1
+optimal rounds; no stored reference numbers exist for it, so `vs_baseline`
+is the device/host ratio measured in this same process).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+COMMITTEE = 20
+ROUNDS = 120
+GC = 50
+
+
+def _stream(size: int, rounds: int):
+    from narwhal_tpu.fixtures import CommitteeFixture, make_certificates
+    from narwhal_tpu.types import Certificate
+
+    f = CommitteeFixture(size=size)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_certificates(
+        f.committee, 1, rounds, genesis, failure_probability=0.1,
+        rng=random.Random(7),
+    )
+    return f, certs
+
+
+def _drive(engine_factory, fixture, certs) -> tuple[float, int]:
+    from narwhal_tpu.consensus import ConsensusState
+    from narwhal_tpu.types import Certificate
+
+    engine = engine_factory()
+    state = ConsensusState(Certificate.genesis(fixture.committee))
+    committed = 0
+    index = 0
+    t0 = time.perf_counter()
+    for c in certs:
+        out = engine.process_certificate(state, index, c)
+        index += len(out)
+        committed += len(out)
+    dt = time.perf_counter() - t0
+    assert committed > 0, "bench stream produced no commits"
+    return len(certs) / dt, committed
+
+
+def main() -> None:
+    from narwhal_tpu.consensus import Bullshark
+    from narwhal_tpu.stores import NodeStorage
+    from narwhal_tpu.tpu.dag_kernels import TpuBullshark
+
+    fixture, certs = _stream(COMMITTEE, ROUNDS)
+
+    def host():
+        return Bullshark(fixture.committee, NodeStorage(None).consensus_store, GC)
+
+    def device():
+        return TpuBullshark(fixture.committee, NodeStorage(None).consensus_store, GC)
+
+    # Warmup (jit compile) on a short prefix, then timed runs.
+    warm_f, warm_certs = _stream(COMMITTEE, 10)
+    _drive(device, warm_f, warm_certs)
+
+    host_rate, host_committed = _drive(host, fixture, certs)
+    dev_rate, dev_committed = _drive(device, fixture, certs)
+    assert host_committed == dev_committed, (host_committed, dev_committed)
+
+    print(
+        json.dumps(
+            {
+                "metric": "bullshark_ordering_certs_per_s",
+                "value": round(dev_rate, 1),
+                "unit": "certs/s",
+                "vs_baseline": round(dev_rate / host_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
