@@ -2,5 +2,13 @@ from .state import ConsensusState
 from .bullshark import Bullshark
 from .tusk import Tusk
 from .runner import Consensus
+from .dag import Dag, ValidatorDagError
 
-__all__ = ["ConsensusState", "Bullshark", "Tusk", "Consensus"]
+__all__ = [
+    "ConsensusState",
+    "Bullshark",
+    "Tusk",
+    "Consensus",
+    "Dag",
+    "ValidatorDagError",
+]

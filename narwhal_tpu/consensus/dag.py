@@ -1,0 +1,202 @@
+"""External-consensus Dag service over the generic compressed DAG.
+
+Reference: /root/reference/consensus/src/dag.rs:37-516 — an actor holding
+`NodeDag<Certificate>` plus a `(PublicKey, Round) -> Digest` index, serving
+Insert/Contains/HasEverContained/Rounds/ReadCausal/NodeReadCausal/Remove/
+NotifyRead; GC is mark (remove -> make_compressible) and sweep (triggered by
+`rounds`). Genesis certificates are inserted at construction and, being
+payload-empty, are compressible — DAG walks never report them
+(types/src/primary.rs:633-644).
+
+Here the actor mailbox is replaced by a single asyncio lock: our runtime is
+one event loop, so serialized async methods give the identical external
+behavior without the command-enum plumbing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import defaultdict
+
+from ..channels import Channel
+from ..config import Committee
+from ..dag import DroppedDigest, NodeDag, UnknownDigests
+from ..types import Certificate, Digest, PublicKey, Round
+
+logger = logging.getLogger("narwhal.consensus.dag")
+
+
+class ValidatorDagError(Exception):
+    pass
+
+
+class OutOfCertificates(ValidatorDagError):
+    def __init__(self, origin: PublicKey):
+        super().__init__(f"no certificates for origin {origin.hex()[:16]}")
+
+
+class NoCertificateForCoordinates(ValidatorDagError):
+    def __init__(self, origin: PublicKey, round: Round):
+        super().__init__(f"no certificate at ({origin.hex()[:16]}, {round})")
+
+
+class _CertVertex:
+    """Adapter giving Certificate the Affiliated shape (digest attr +
+    parents()/compressible() methods)."""
+
+    __slots__ = ("cert",)
+
+    def __init__(self, cert: Certificate):
+        self.cert = cert
+
+    @property
+    def digest(self) -> Digest:
+        return self.cert.digest
+
+    def parents(self) -> list[Digest]:
+        return sorted(self.cert.header.parents)
+
+    def compressible(self) -> bool:
+        # Genesis and empty blocks never show up in causal reads.
+        return not self.cert.header.payload
+
+
+class Dag:
+    """The external consensus: certificates in, queryable DAG out.
+
+    `spawn()` attaches the feed from the primary's tx_new_certificates
+    channel (node/src/lib.rs:198-213); all query methods are usable with or
+    without the feed running.
+    """
+
+    def __init__(self, committee: Committee, rx_primary: Channel | None = None):
+        self.rx_primary = rx_primary
+        self._dag: NodeDag = NodeDag()
+        self._vertices: dict[tuple[PublicKey, Round], Digest] = {}
+        self._lock = asyncio.Lock()
+        self._obligations: dict[Digest, list[asyncio.Future]] = defaultdict(list)
+        self._task: asyncio.Task | None = None
+        for cert in Certificate.genesis(committee):
+            self._insert(cert)
+
+    # -- feed -------------------------------------------------------------
+
+    def spawn(self) -> asyncio.Task:
+        self._task = asyncio.ensure_future(self._run())
+        return self._task
+
+    async def _run(self) -> None:
+        assert self.rx_primary is not None, "spawn() needs the primary feed"
+        while True:
+            certificate: Certificate = await self.rx_primary.recv()
+            async with self._lock:
+                # Core guarantees causal completion before handing certs over.
+                try:
+                    self._insert(certificate)
+                except UnknownDigests as e:
+                    logger.warning("dag feed: missing parents %s", e.digests)
+
+    # -- internals (lock held by callers of the async wrappers) -----------
+
+    def _insert(self, certificate: Certificate) -> None:
+        self._dag.try_insert(_CertVertex(certificate))
+        self._vertices[(certificate.origin, certificate.round)] = certificate.digest
+        for fut in self._obligations.pop(certificate.digest, []):
+            if not fut.done():
+                fut.set_result(certificate)
+
+    # -- commands (consensus/src/dag.rs:370-516) ---------------------------
+
+    async def insert(self, certificate: Certificate) -> None:
+        async with self._lock:
+            self._insert(certificate)
+
+    async def contains(self, digest: Digest) -> bool:
+        async with self._lock:
+            return self._dag.contains_live(digest)
+
+    async def has_ever_contained(self, digest: Digest) -> bool:
+        async with self._lock:
+            return self._dag.contains(digest)
+
+    async def rounds(self, origin: PublicKey) -> tuple[Round, Round]:
+        """(earliest, latest) live rounds for a validator; triggers the GC
+        sweep first so answers match subsequent read_causal results."""
+        async with self._lock:
+            if self._dag.sweep():
+                # Prune the coordinate index of tombstoned vertices, or it
+                # grows with total history (the reference cleans it here too).
+                self._vertices = {
+                    k: d
+                    for k, d in self._vertices.items()
+                    if self._dag.contains_live(d)
+                }
+            alive = sorted(
+                r
+                for (pk, r), digest in self._vertices.items()
+                if pk == origin and self._dag.contains_live(digest)
+            )
+            if not alive:
+                raise OutOfCertificates(origin)
+            return alive[0], alive[-1]
+
+    async def read_causal(self, start: Digest) -> list[Digest]:
+        """BFS of the causal history of `start` over live vertices; bypassed
+        (compressible) vertices are never reported."""
+        async with self._lock:
+            try:
+                return [v.cert.digest for v in self._dag.bft(start)]
+            except (UnknownDigests, DroppedDigest) as e:
+                raise ValidatorDagError(str(e)) from e
+
+    async def node_read_causal(self, origin: PublicKey, round: Round) -> list[Digest]:
+        async with self._lock:
+            digest = self._vertices.get((origin, round))
+            if digest is None:
+                raise NoCertificateForCoordinates(origin, round)
+            try:
+                return [v.cert.digest for v in self._dag.bft(digest)]
+            except (UnknownDigests, DroppedDigest) as e:
+                raise ValidatorDagError(str(e)) from e
+
+    async def remove(self, digests: list[Digest]) -> None:
+        """Mark certificates for compression and drop them from the
+        coordinate index; unknown digests error, already-dropped are fine."""
+        async with self._lock:
+            unknown: list[Digest] = []
+            todrop = set(digests)
+            for digest in todrop:
+                try:
+                    self._dag.make_compressible(digest)
+                except UnknownDigests:
+                    unknown.append(digest)
+                except DroppedDigest:
+                    pass
+            self._vertices = {
+                k: v for k, v in self._vertices.items() if v not in todrop
+            }
+            if unknown:
+                raise ValidatorDagError(f"unknown digests {unknown!r}")
+
+    async def notify_read(self, digest: Digest) -> Certificate:
+        async with self._lock:
+            try:
+                return self._dag.get(digest).cert
+            except DroppedDigest:
+                raise ValidatorDagError(f"{digest!r} was dropped")
+            except UnknownDigests:
+                fut = asyncio.get_running_loop().create_future()
+                self._obligations[digest].append(fut)
+        return await fut
+
+    def size(self) -> int:
+        return self._dag.size()
+
+    async def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

@@ -15,7 +15,7 @@ import logging
 
 from .channels import Channel
 from .config import Committee, Parameters, WorkerCache
-from .consensus import Bullshark, Consensus, Tusk
+from .consensus import Bullshark, Consensus, Dag, Tusk
 from .consensus.metrics import ConsensusMetrics
 from .crypto import KeyPair, SignatureService
 from .executor import (
@@ -109,6 +109,7 @@ class PrimaryNode:
 
         self.consensus: Consensus | None = None
         self.executor: Executor | None = None
+        self.dag: Dag | None = None
         self.execution_state = execution_state or SimpleExecutionState(storage)
         if internal_consensus:
             protocol_cls = {"bullshark": Bullshark, "tusk": Tusk}[consensus_protocol]
@@ -136,6 +137,10 @@ class PrimaryNode:
                 self.tx_consensus_output,
                 self.tx_execution_output,
             )
+        else:
+            # External consensus: the Dag service consumes the certificate
+            # stream and serves causal queries (node/src/lib.rs:198-213).
+            self.dag = Dag(committee, self.tx_new_certificates)
         self._tasks: list[asyncio.Task] = []
 
     @property
@@ -157,15 +162,8 @@ class PrimaryNode:
             self._tasks.append(self.consensus.spawn())
         if self.executor is not None:
             self._tasks.extend(await self.executor.spawn(restored))
-        if not self.internal_consensus:
-            # The external Dag service is this channel's consumer in the
-            # reference (node/src/lib.rs:198-213); until a Dag is attached,
-            # drain it so the Core never blocks on a full channel.
-            async def drain() -> None:
-                while True:
-                    await self.tx_new_certificates.recv()
-
-            self._tasks.append(asyncio.ensure_future(drain()))
+        if self.dag is not None:
+            self._tasks.append(self.dag.spawn())
 
     async def shutdown(self) -> None:
         for t in self._tasks:

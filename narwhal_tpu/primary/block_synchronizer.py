@@ -1,0 +1,269 @@
+"""BlockSynchronizer: fetch missing certificates and payloads from peers.
+
+Reference: /root/reference/primary/src/block_synchronizer/{mod,handler,
+peers}.rs — three flows:
+
+- `synchronize_block_headers(digests)`: certificates we lack are requested
+  from peer primaries (`CertificatesBatchRequest`); responses are verified
+  and re-injected into the Core (loopback channel) for causal completion,
+  exactly like handler.rs:200-260.
+- `synchronize_block_payloads(certs)`: ask peers who holds each payload
+  (`PayloadAvailabilityRequest`), then instruct our workers to `Synchronize`
+  the batches from the matching peer workers; completion is awaited on the
+  payload store's notify primitive.
+- `synchronize_range(from_round)`: restart catch-up — collect certificate
+  digests above our last round from peers (`CertificatesRangeRequest`) and
+  pull the certificates (mod.rs:75-83).
+
+Peer selection keeps a simple success score per peer (peers.rs weights) and
+asks the best `ask_nodes` peers concurrently, first sufficient answer wins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from collections import defaultdict
+
+from ..config import Committee, Parameters, WorkerCache
+from ..messages import (
+    CertificatesBatchRequest,
+    CertificatesBatchResponse,
+    CertificatesRangeRequest,
+    CertificatesRangeResponse,
+    PayloadAvailabilityRequest,
+    PayloadAvailabilityResponse,
+    SynchronizeMsg,
+)
+from ..network import NetworkClient, RpcError
+from ..stores import CertificateStore, PayloadStore
+from ..types import Certificate, Digest, PublicKey
+
+logger = logging.getLogger("narwhal.primary")
+
+CERTIFICATE_RESPONSES_RATIO_THRESHOLD = 0.5  # mod.rs:58
+
+
+class BlockSynchronizer:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        certificate_store: CertificateStore,
+        payload_store: PayloadStore,
+        network: NetworkClient,
+        parameters: Parameters,
+        tx_loopback=None,  # re-inject fetched certificates into the Core
+    ):
+        self.name = name
+        self.committee = committee
+        self.worker_cache = worker_cache
+        self.certificate_store = certificate_store
+        self.payload_store = payload_store
+        self.network = network
+        self.parameters = parameters
+        self.tx_loopback = tx_loopback
+        self._scores: dict[PublicKey, int] = defaultdict(int)  # peers.rs
+
+    # -- peer selection ---------------------------------------------------
+
+    def _peers(self, count: int) -> list[tuple[PublicKey, str]]:
+        others = [
+            (pk, address)
+            for pk, address, _net in self.committee.others_primaries(self.name)
+        ]
+        random.shuffle(others)
+        others.sort(key=lambda pa: -self._scores[pa[0]])
+        return others[:count]
+
+    # -- certificates -----------------------------------------------------
+
+    async def synchronize_block_headers(
+        self, digests: list[Digest], timeout: float | None = None
+    ) -> list[Certificate]:
+        """Return certificates for `digests`, fetching missing ones from
+        peers; fetched certificates are verified, stored via the Core
+        loopback, and returned."""
+        found: dict[Digest, Certificate] = {}
+        missing: list[Digest] = []
+        for digest in digests:
+            cert = self.certificate_store.read(digest)
+            if cert is not None:
+                found[digest] = cert
+            else:
+                missing.append(digest)
+        if missing:
+            fetched = await self._fetch_certificates(
+                missing, timeout or self.parameters.sync_retry_delay * 4
+            )
+            for cert in fetched:
+                found[cert.digest] = cert
+        return [found[d] for d in digests if d in found]
+
+    async def _fetch_certificates(
+        self, digests: list[Digest], timeout: float
+    ) -> list[Certificate]:
+        peers = self._peers(self.parameters.sync_retry_nodes)
+        if not peers:
+            return []
+
+        async def ask(peer: PublicKey, address: str) -> list[Certificate]:
+            resp: CertificatesBatchResponse = await self.network.request(
+                address, CertificatesBatchRequest(tuple(digests)), timeout=timeout
+            )
+            got = [c for _, c in resp.certificates if c is not None]
+            self._scores[peer] += 1
+            return got
+
+        tasks = [asyncio.ensure_future(ask(p, a)) for p, a in peers]
+        wanted = set(digests)
+        collected: dict[Digest, Certificate] = {}
+        try:
+            for fut in asyncio.as_completed(tasks, timeout=timeout):
+                try:
+                    certs = await fut
+                except (RpcError, OSError, asyncio.TimeoutError):
+                    continue
+                for cert in certs:
+                    if cert.digest in wanted and cert.digest not in collected:
+                        try:
+                            cert.verify(self.committee, self.worker_cache)
+                        except Exception as e:
+                            logger.warning("peer sent invalid certificate: %s", e)
+                            continue
+                        collected[cert.digest] = cert
+                if len(collected) == len(wanted):
+                    break
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            for t in tasks:
+                t.cancel()
+        # Hand fetched certificates to the Core for causal completion +
+        # storage (handler.rs:233-249).
+        if self.tx_loopback is not None:
+            for cert in collected.values():
+                await self.tx_loopback.send(cert)
+        return list(collected.values())
+
+    # -- payloads ---------------------------------------------------------
+
+    async def synchronize_block_payloads(
+        self, certificates: list[Certificate], timeout: float | None = None
+    ) -> list[Certificate]:
+        """Ensure the payload of each certificate is available in our
+        workers' stores; returns the certificates whose payload arrived."""
+        timeout = timeout or self.parameters.sync_retry_delay * 4
+        pending = [
+            c
+            for c in certificates
+            if any(
+                not self.payload_store.contains(bd, wid)
+                for bd, wid in c.header.payload.items()
+            )
+        ]
+        if pending:
+            providers = await self._payload_providers(pending, timeout)
+            await self._request_worker_sync(pending, providers)
+
+        async def wait_for(cert: Certificate) -> Certificate | None:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(
+                        *(
+                            self.payload_store.notify_contains(bd, wid)
+                            for bd, wid in cert.header.payload.items()
+                        )
+                    ),
+                    timeout,
+                )
+                return cert
+            except asyncio.TimeoutError:
+                return None
+
+        results = await asyncio.gather(*(wait_for(c) for c in certificates))
+        return [c for c in results if c is not None]
+
+    async def _payload_providers(
+        self, certificates: list[Certificate], timeout: float
+    ) -> dict[Digest, list[PublicKey]]:
+        """Which peers can serve each certificate's payload?"""
+        digests = tuple(c.digest for c in certificates)
+        peers = self._peers(self.parameters.sync_retry_nodes)
+        providers: dict[Digest, list[PublicKey]] = defaultdict(list)
+
+        async def ask(peer: PublicKey, address: str) -> None:
+            resp: PayloadAvailabilityResponse = await self.network.request(
+                address, PayloadAvailabilityRequest(digests), timeout=timeout
+            )
+            for digest, available in resp.available:
+                if available:
+                    providers[digest].append(peer)
+            self._scores[peer] += 1
+
+        await asyncio.gather(
+            *(ask(p, a) for p, a in peers), return_exceptions=True
+        )
+        return providers
+
+    async def _request_worker_sync(
+        self,
+        certificates: list[Certificate],
+        providers: dict[Digest, list[PublicKey]],
+    ) -> None:
+        """Tell our workers which batches to pull and from whom."""
+        by_worker: dict[int, dict[PublicKey, list[Digest]]] = defaultdict(
+            lambda: defaultdict(list)
+        )
+        for cert in certificates:
+            targets = providers.get(cert.digest) or [cert.origin]
+            target = targets[0]
+            for batch_digest, worker_id in cert.header.payload.items():
+                if not self.payload_store.contains(batch_digest, worker_id):
+                    by_worker[worker_id][target].append(batch_digest)
+        for worker_id, per_target in by_worker.items():
+            info = self.worker_cache.worker(self.name, worker_id)
+            for target, batch_digests in per_target.items():
+                await self.network.unreliable_send(
+                    info.worker_address,
+                    SynchronizeMsg(tuple(batch_digests), target),
+                )
+
+    # -- range catch-up ---------------------------------------------------
+
+    async def synchronize_range(
+        self, from_round: int, to_round: int | None = None, timeout: float = 5.0
+    ) -> list[Digest]:
+        """Collect certificate digests in (from_round, to_round] known to a
+        quorum-ish of peers (mod.rs SynchronizeRange), then fetch them."""
+        peers = self._peers(max(self.parameters.sync_retry_nodes, 3))
+        if not peers:
+            return []
+        req = CertificatesRangeRequest(from_round, to_round or (1 << 62))
+        counts: dict[Digest, int] = defaultdict(int)
+        answers = 0
+
+        async def ask(peer: PublicKey, address: str) -> None:
+            nonlocal answers
+            resp: CertificatesRangeResponse = await self.network.request(
+                address, req, timeout=timeout
+            )
+            answers += 1
+            for digest in resp.digests:
+                counts[digest] += 1
+            self._scores[peer] += 1
+
+        await asyncio.gather(*(ask(p, a) for p, a in peers), return_exceptions=True)
+        if answers == 0:
+            return []
+        threshold = max(1, int(answers * CERTIFICATE_RESPONSES_RATIO_THRESHOLD))
+        wanted = [
+            d
+            for d, n in counts.items()
+            if n >= threshold and not self.certificate_store.contains(d)
+        ]
+        if wanted:
+            await self._fetch_certificates(wanted, timeout)
+        return wanted

@@ -1,0 +1,117 @@
+"""BlockWaiter: fetch a certificate's payload ("block"/"collection") from our
+own workers.
+
+Reference: /root/reference/primary/src/block_waiter.rs:45-845 — GetBlock /
+GetBlocks commands resolve a certificate digest to its batches by sending
+`RequestBatch` to the worker that holds each batch; concurrent requests for
+the same block are deduplicated; batch requests time out after 10s. Used by
+the executor's subscriber and the Validator gRPC API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+
+from ..config import WorkerCache
+from ..messages import RequestBatchMsg, RequestedBatchMsg
+from ..network import NetworkClient, RpcError
+from ..stores import CertificateStore
+from ..types import Batch, Certificate, Digest, PublicKey
+
+logger = logging.getLogger("narwhal.primary")
+
+BATCH_RETRIEVE_TIMEOUT = 10.0
+
+
+class BlockError(Exception):
+    def __init__(self, digest: Digest, kind: str):
+        super().__init__(f"block {digest.hex()[:16]}: {kind}")
+        self.digest = digest
+        self.kind = kind  # "BlockNotFound" | "BatchTimeout" | "BatchError"
+
+
+@dataclass
+class BlockResponse:
+    digest: Digest
+    batches: list[tuple[Digest, Batch]]
+
+
+class BlockWaiter:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_cache: WorkerCache,
+        certificate_store: CertificateStore,
+        network: NetworkClient,
+        block_synchronizer=None,  # optional: fetch unknown certs from peers
+    ):
+        self.name = name
+        self.worker_cache = worker_cache
+        self.certificate_store = certificate_store
+        self.network = network
+        self.block_synchronizer = block_synchronizer
+        # Dedup map: one in-flight fetch per block digest
+        # (block_waiter.rs pending_get_block).
+        self._pending: dict[Digest, asyncio.Future] = {}
+
+    async def get_block(self, digest: Digest) -> BlockResponse:
+        fut = self._pending.get(digest)
+        if fut is None:
+            fut = asyncio.ensure_future(self._fetch_block(digest))
+            self._pending[digest] = fut
+            fut.add_done_callback(lambda _: self._pending.pop(digest, None))
+        return await asyncio.shield(fut)
+
+    async def get_blocks(self, digests: list[Digest]) -> list[BlockResponse | BlockError]:
+        results = await asyncio.gather(
+            *(self.get_block(d) for d in digests), return_exceptions=True
+        )
+        out: list[BlockResponse | BlockError] = []
+        for digest, res in zip(digests, results):
+            if isinstance(res, BlockResponse):
+                out.append(res)
+            elif isinstance(res, BlockError):
+                out.append(res)
+            else:
+                out.append(BlockError(digest, "BatchError"))
+        return out
+
+    async def _certificate(self, digest: Digest) -> Certificate | None:
+        cert = self.certificate_store.read(digest)
+        if cert is None and self.block_synchronizer is not None:
+            certs = await self.block_synchronizer.synchronize_block_headers([digest])
+            for c in certs:
+                if c.digest == digest:
+                    return c
+        return cert
+
+    async def _fetch_block(self, digest: Digest) -> BlockResponse:
+        certificate = await self._certificate(digest)
+        if certificate is None:
+            raise BlockError(digest, "BlockNotFound")
+        payload = list(certificate.header.payload.items())
+        try:
+            batches = await asyncio.wait_for(
+                asyncio.gather(
+                    *(self._fetch_batch(d, w) for d, w in payload)
+                ),
+                BATCH_RETRIEVE_TIMEOUT,
+            )
+        except asyncio.TimeoutError:
+            raise BlockError(digest, "BatchTimeout") from None
+        except (RpcError, OSError, KeyError) as e:
+            logger.debug("block %s batch error: %s", digest.hex()[:16], e)
+            raise BlockError(digest, "BatchError") from None
+        return BlockResponse(digest, list(zip((d for d, _ in payload), batches)))
+
+    async def _fetch_batch(self, batch_digest: Digest, worker_id: int) -> Batch:
+        info = self.worker_cache.worker(self.name, worker_id)
+        resp: RequestedBatchMsg = await self.network.request(
+            info.worker_address, RequestBatchMsg(batch_digest)
+        )
+        batch = Batch(resp.transactions)
+        if batch.digest != batch_digest:  # missing (empty reply) or corrupt
+            raise RpcError(f"worker {worker_id} lacks batch {batch_digest.hex()[:16]}")
+        return batch

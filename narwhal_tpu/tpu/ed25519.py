@@ -1,0 +1,353 @@
+"""Batched ed25519 verification on TPU: the north-star crypto kernel.
+
+Replaces per-message host verification (the reference's ed25519-dalek calls
+behind fastcrypto's `VerifyingKey`, /root/reference/crypto/src/lib.rs:29-46;
+hot at `Certificate::verify`, /root/reference/types/src/primary.rs:487-537)
+with one device dispatch per batch of signatures.
+
+TPU-first design notes (see /opt/skills/guides/pallas_guide.md and SURVEY §7.8a):
+
+- **Field arithmetic mod p = 2^255-19 in radix 2^13**: 20 int32 limbs.
+  Products of two 13-bit limbs are 26-bit; a 39-term school-book column sum
+  stays under 2^31, so the whole multiplier runs in native int32 lanes on the
+  VPU — no 64-bit emulation, no dynamic shapes. Static-shift partial products
+  (an unrolled 20-tap convolution) vectorize across the batch axis.
+- **Reduction** folds limb k+20 back with weight 608 (2^260 ≡ 19·2^5), then
+  the bit-255 overflow with weight 19; limbs stay "almost reduced" (< 2p)
+  except where equality tests require canonical form.
+- **One traced scalar path, vmapped**: verification is written for a single
+  signature and `jax.vmap`-ed, so XLA sees a fixed-shape [B, ...] program with
+  a `lax.scan` over the 64 windowed-scalar steps.
+- **Shared-doubling Straus**: Rcheck = [S]B + [k](-A) computed with one run
+  of 252 doublings and 2x64 table additions (4-bit windows); the B table is a
+  host-precomputed constant (ed25519_ref.base_window_table), the -A table is
+  built on device (15 additions). The extended-Edwards addition law is
+  complete on this curve, so identity entries need no branches — exactly the
+  compiler-friendly control flow the MXU/VPU pipeline wants.
+- Verification equation matches the host library (cofactorless):
+  encode([S]B - [k]A) == R bytes, with canonicality prechecks on host.
+
+The host wrapper lives in narwhal_tpu/tpu/verifier.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import ed25519_ref as ref
+
+NLIMB = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1
+WINDOWS = 64  # 4-bit windows over 256-bit scalars, MSB first
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMB)], np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+_P_LIMBS = int_to_limbs(ref.P)
+_2P_LIMBS = (2 * _P_LIMBS).astype(np.int32)
+_D = int_to_limbs(ref.D)
+_2D = int_to_limbs(2 * ref.D % ref.P)
+_SQRT_M1 = int_to_limbs(ref.SQRT_M1)
+_ONE = int_to_limbs(1)
+_ZERO = int_to_limbs(0)
+
+# Fixed-base window table: 16 small multiples of B in affine (x, y, x*y),
+# identity at index 0 as (0, 1, 0) with its Z supplied as 1 on device.
+_BT = np.zeros((16, 3, NLIMB), np.int32)
+for _d, (_x, _y, _t) in enumerate(ref.base_window_table()):
+    _BT[_d, 0] = int_to_limbs(_x)
+    _BT[_d, 1] = int_to_limbs(_y)
+    _BT[_d, 2] = int_to_limbs(_t)
+
+
+# ---------------------------------------------------------------------------
+# Field element ops. A field element is an int32[NLIMB] array; all functions
+# keep limbs in [0, 2^13) ("reduced form", value possibly in [p, 2p)).
+# ---------------------------------------------------------------------------
+
+
+def _carry_chain(c, n):
+    """Sequential carry propagation over n limbs; returns (limbs, overflow)."""
+    outs = []
+    carry = jnp.zeros_like(c[..., 0])
+    for i in range(n):
+        v = c[..., i] + carry
+        outs.append(v & MASK)
+        carry = v >> RADIX
+    return jnp.stack(outs, axis=-1), carry
+
+
+def _fold255(r, overflow):
+    """Fold bits >= 255 (limb 19 bits 8+, plus any limb-20 overflow) back in
+    with weight 19, then one more carry pass."""
+    top = r[..., NLIMB - 1]
+    hi = (top >> 8) + (overflow << (RADIX - 8))
+    r = r.at[..., NLIMB - 1].set(top & 0xFF)
+    r = r.at[..., 0].add(19 * hi)
+    r, _ = _carry_chain(r, NLIMB)
+    return r
+
+
+def fe_reduce(r):
+    """Reduce an int32[NLIMB] with limbs < ~2^30 to reduced form."""
+    r, overflow = _carry_chain(r, NLIMB)
+    return _fold255(r, overflow)
+
+
+def fe_add(a, b):
+    return fe_reduce(a + b)
+
+
+def fe_sub(a, b):
+    return fe_reduce(a + jnp.asarray(_2P_LIMBS) - b)
+
+
+def fe_neg(a):
+    return fe_reduce(jnp.asarray(_2P_LIMBS) - a)
+
+
+def fe_mul(a, b):
+    # School-book columns via static shifts: c[k] = sum_{i+j=k} a_i * b_j.
+    c = jnp.zeros(a.shape[:-1] + (2 * NLIMB,), jnp.int32)
+    for i in range(NLIMB):
+        c = c.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    c, _ = _carry_chain(c, 2 * NLIMB)  # no carry out of limb 39: c_38 < 2^31
+    # 2^260 == 19 * 2^5 == 608 (mod p): fold the high half down.
+    r = c[..., :NLIMB] + 608 * c[..., NLIMB:]
+    r, overflow = _carry_chain(r, NLIMB)
+    return _fold255(r, overflow)
+
+
+def fe_sq(a):
+    return fe_mul(a, a)
+
+
+def fe_canonical(a):
+    """Full reduction to [0, p): conditionally subtract p twice."""
+    for _ in range(2):
+        borrow = jnp.zeros_like(a[..., 0])
+        outs = []
+        for i in range(NLIMB):
+            v = a[..., i] - int(_P_LIMBS[i]) - borrow
+            borrow = (v < 0).astype(jnp.int32)
+            outs.append(v + (borrow << RADIX))
+        sub = jnp.stack(outs, axis=-1)
+        a = jnp.where((borrow == 0)[..., None], sub, a)
+    return a
+
+
+def fe_eq(a, b):
+    """Equality of field values (canonicalizes both)."""
+    return jnp.all(fe_canonical(a) == fe_canonical(b), axis=-1)
+
+
+def fe_is_zero(a):
+    return jnp.all(fe_canonical(a) == 0, axis=-1)
+
+
+def _ladder(z):
+    """Shared exponentiation ladder: returns (z^(2^250-1), z^11)."""
+    t0 = fe_sq(z)  # z^2
+    t1 = fe_sq(fe_sq(t0))  # z^8
+    t1 = fe_mul(z, t1)  # z^9
+    t0 = fe_mul(t0, t1)  # z^11
+    t2 = fe_sq(t0)  # z^22
+    t1 = fe_mul(t1, t2)  # z^31 = z^(2^5-1)
+    z11 = t0
+
+    def times(x, n):
+        # fori_loop keeps the compiled graph small: one fe_sq body per chain
+        # instead of n inlined copies (squarings are sequential regardless).
+        if n <= 4:
+            for _ in range(n):
+                x = fe_sq(x)
+            return x
+        return lax.fori_loop(0, n, lambda _, v: fe_sq(v), x)
+
+    t2 = times(t1, 5)
+    t1 = fe_mul(t2, t1)  # z^(2^10-1)
+    t2 = times(t1, 10)
+    t2 = fe_mul(t2, t1)  # z^(2^20-1)
+    t3 = times(t2, 20)
+    t2 = fe_mul(t3, t2)  # z^(2^40-1)
+    t2 = times(t2, 10)
+    t1 = fe_mul(t2, t1)  # z^(2^50-1)
+    t2 = times(t1, 50)
+    t2 = fe_mul(t2, t1)  # z^(2^100-1)
+    t3 = times(t2, 100)
+    t2 = fe_mul(t3, t2)  # z^(2^200-1)
+    t2 = times(t2, 50)
+    t1 = fe_mul(t2, t1)  # z^(2^250-1)
+    return t1, z11
+
+
+def fe_invert(z):
+    t1, z11 = _ladder(z)
+    for _ in range(5):
+        t1 = fe_sq(t1)  # z^(2^255-2^5)
+    return fe_mul(t1, z11)  # z^(2^255-21) = z^(p-2)
+
+
+def fe_pow22523(z):
+    t1, _ = _ladder(z)
+    t1 = fe_sq(fe_sq(t1))  # z^(2^252-4)
+    return fe_mul(t1, z)  # z^(2^252-3)
+
+
+# ---------------------------------------------------------------------------
+# Point ops: extended twisted-Edwards coordinates, stacked as [4, NLIMB]
+# rows (X, Y, Z, T). The addition law is complete on ed25519.
+# ---------------------------------------------------------------------------
+
+
+def pt(x, y, z, t):
+    return jnp.stack([x, y, z, t], axis=-2)
+
+
+def pt_identity():
+    return pt(
+        jnp.asarray(_ZERO), jnp.asarray(_ONE), jnp.asarray(_ONE), jnp.asarray(_ZERO)
+    )
+
+
+def pt_add(p, q):
+    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
+    x2, y2, z2, t2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
+    b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
+    c = fe_mul(fe_mul(t1, jnp.asarray(_2D)), t2)
+    d = fe_mul(fe_add(z1, z1), z2)
+    e, f, g, h = fe_sub(b, a), fe_sub(d, c), fe_add(d, c), fe_add(b, a)
+    return pt(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_double(p):
+    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    a = fe_sq(x1)
+    b = fe_sq(y1)
+    c = fe_add(fe_sq(z1), fe_sq(z1))
+    h = fe_add(a, b)
+    e = fe_sub(h, fe_sq(fe_add(x1, y1)))
+    g = fe_sub(a, b)
+    f = fe_add(c, g)
+    return pt(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_neg(p):
+    return pt(fe_neg(p[..., 0, :]), p[..., 1, :], p[..., 2, :], fe_neg(p[..., 3, :]))
+
+
+# ---------------------------------------------------------------------------
+# Decompression and verification (single signature; vmapped below).
+# ---------------------------------------------------------------------------
+
+
+def decompress(y_limbs, sign):
+    """Recover x from a (reduced-form) y and sign bit. Returns (point, valid)."""
+    y2 = fe_sq(y_limbs)
+    u = fe_sub(y2, jnp.asarray(_ONE))
+    v = fe_add(fe_mul(y2, jnp.asarray(_D)), jnp.asarray(_ONE))
+    v3 = fe_mul(fe_sq(v), v)
+    v7 = fe_mul(fe_sq(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow22523(fe_mul(u, v7)))
+    vx2 = fe_mul(v, fe_sq(x))
+    correct = fe_eq(vx2, u)
+    flipped = fe_eq(vx2, fe_neg(u))
+    valid = correct | flipped
+    x = jnp.where(flipped[..., None], fe_mul(x, jnp.asarray(_SQRT_M1)), x)
+    x_can = fe_canonical(x)
+    x_zero = jnp.all(x_can == 0, axis=-1)
+    valid = valid & ~(x_zero & (sign == 1))
+    parity = x_can[..., 0] & 1
+    x = jnp.where((parity != sign)[..., None], fe_neg(x), x)
+    point = pt(x, y_limbs, jnp.asarray(_ONE), fe_mul(x, y_limbs))
+    return point, valid
+
+
+def _table_entry_affine(table, digit):
+    """Extended point from an affine (x, y, t) table row; identity-safe
+    because row 0 is (0, 1, 0) and Z is forced to 1."""
+    row = jnp.take(table, digit, axis=0)  # [3, NLIMB]
+    return pt(row[0], row[1], jnp.asarray(_ONE), row[2])
+
+
+def verify_one(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
+    """Cofactorless check: encode([S]B + [k](-A)) == (r_y, r_sign).
+
+    a_y/r_y: int32[NLIMB] reduced-form y coordinates (canonical, from host);
+    *_sign: int32 scalars; k_digits/s_digits: int32[WINDOWS] 4-bit digits,
+    MSB first. Returns bool.
+    """
+    a_point, valid = decompress(a_y, a_sign)
+    neg_a = pt_neg(a_point)
+
+    # 16 multiples of -A (device); 16 multiples of B (host constant).
+    def next_multiple(prev, _):
+        nxt = pt_add(prev, neg_a)
+        return nxt, nxt
+
+    _, higher = lax.scan(next_multiple, neg_a, None, length=14)  # 2A..15A
+    table_a = jnp.concatenate(
+        [pt_identity()[None], neg_a[None], higher], axis=0
+    )  # [16, 4, NLIMB]
+    table_b = jnp.asarray(_BT)  # [16, 3, NLIMB]
+
+    def step(acc, digits):
+        kd, sd = digits
+        for _ in range(4):
+            acc = pt_double(acc)
+        acc = pt_add(acc, jnp.take(table_a, kd, axis=0))
+        acc = pt_add(acc, _table_entry_affine(table_b, sd))
+        return acc, None
+
+    acc, _ = lax.scan(step, pt_identity(), (k_digits, s_digits))
+
+    zinv = fe_invert(acc[2])
+    x = fe_mul(acc[0], zinv)
+    y = fe_mul(acc[1], zinv)
+    x_can = fe_canonical(x)
+    ok = fe_eq(y, r_y) & ((x_can[..., 0] & 1) == r_sign)
+    return ok & valid
+
+
+@functools.partial(jax.jit, static_argnames=())
+def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
+    """[B]-batched verification; every argument's leading axis is the batch."""
+    return jax.vmap(verify_one)(a_y, a_sign, r_y, r_sign, k_digits, s_digits)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing helpers (numpy, vectorized over the batch).
+# ---------------------------------------------------------------------------
+
+
+def bytes_to_limbs(raw: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 little-endian -> [B, NLIMB] int32 (sign bit cleared)."""
+    raw = raw.copy()
+    raw[:, 31] &= 0x7F
+    bits = np.unpackbits(raw, axis=1, bitorder="little")  # [B, 256]
+    bits = np.pad(bits, ((0, 0), (0, NLIMB * RADIX - 256)))
+    weights = (1 << np.arange(RADIX, dtype=np.int32))
+    return (bits.reshape(-1, NLIMB, RADIX) * weights).sum(axis=2).astype(np.int32)
+
+
+def bytes_to_digits(raw: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 little-endian scalars -> [B, WINDOWS] 4-bit digits MSB
+    first."""
+    hi = (raw >> 4).astype(np.int32)
+    lo = (raw & 0xF).astype(np.int32)
+    digits = np.stack([lo, hi], axis=2).reshape(-1, 64)  # LSB-first nibbles
+    return digits[:, ::-1]

@@ -1,0 +1,158 @@
+"""Pure-integer ed25519 group arithmetic: table generation + kernel oracle.
+
+This is the host-side reference the JAX kernel (ed25519.py) is tested
+against, and the generator of the fixed-base window tables it ships to the
+device. Not a hot path: Python ints, readable RFC-8032 math.
+
+Reference behavior being reproduced: the fastcrypto/ed25519-dalek verify the
+reference uses for network identity and (in this framework) protocol
+multisigs (/root/reference/crypto/src/lib.rs:29-46) — cofactorless
+verification: [S]B == R + [k]A with k = SHA-512(R || A || M) mod L.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# Extended coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z.
+IDENTITY = (0, 1, 1, 0)
+
+
+def fe_inv(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+def point_add(p, q):
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * T1 * T2 % P * D % P
+    Dd = 2 * Z1 * Z2 % P
+    E, F, G, H = B - A, Dd - C, Dd + C, B + A
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_double(p):
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    H = A + B
+    E = H - (X1 + Y1) * (X1 + Y1)
+    G = A - B
+    F = C + G
+    return (E * F % P, G * H % P, F * G % P, E * H % P)
+
+
+def point_mul(s: int, p):
+    q = IDENTITY
+    while s > 0:
+        if s & 1:
+            q = point_add(q, p)
+        p = point_double(p)
+        s >>= 1
+    return q
+
+
+def point_neg(p):
+    X, Y, Z, T = p
+    return ((-X) % P, Y, Z, (-T) % P)
+
+
+def point_equal(p, q):
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    return (X1 * Z2 - X2 * Z1) % P == 0 and (Y1 * Z2 - Y2 * Z1) % P == 0
+
+
+def affine(p) -> tuple[int, int]:
+    X, Y, Z, _ = p
+    zi = fe_inv(Z)
+    return X * zi % P, Y * zi % P
+
+
+def recover_x(y: int, sign: int) -> int | None:
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * fe_inv(D * y * y + 1) % P
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+# Base point.
+_GY = 4 * fe_inv(5) % P
+_GX = recover_x(_GY, 0)
+G = (_GX, _GY, 1, _GX * _GY % P)
+
+
+def decompress(s: bytes):
+    if len(s) != 32:
+        return None
+    y = int.from_bytes(s, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def compress(p) -> bytes:
+    x, y = affine(p)
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def sha512_mod_l(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "little") % L
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """Cofactorless RFC-8032-style verification (the oracle for the kernel)."""
+    if len(signature) != 64:
+        return False
+    a = decompress(public_key)
+    if a is None:
+        return False
+    rs, sb = signature[:32], signature[32:]
+    s = int.from_bytes(sb, "little")
+    if s >= L:
+        return False
+    r_int = int.from_bytes(rs, "little")
+    if (r_int & ((1 << 255) - 1)) >= P:  # non-canonical R encoding
+        return False
+    k = sha512_mod_l(rs, public_key, message)
+    rhs = point_add(point_mul(s, G), point_mul(k, point_neg(a)))
+    # rhs = [S]B - [k]A must encode exactly to R.
+    return compress(rhs) == rs
+
+
+def base_window_table(windows: int = 64, width: int = 16):
+    """Affine multiples table for Straus: table[w][d] = affine(d * B) is NOT
+    position-scaled — the kernel shares doublings between both scalars, so it
+    only needs the 16 small multiples of B (and builds A's on device)."""
+    out = []
+    for d in range(width):
+        pt = point_mul(d, G)
+        if d == 0:
+            out.append((0, 1, 0))  # identity in (x, y, t=x*y) affine form
+        else:
+            x, y = affine(pt)
+            out.append((x, y, x * y % P))
+    return out

@@ -1,0 +1,211 @@
+"""TpuVerifier: host wrapper turning (pk, msg, sig) batches into fixed-shape
+device dispatches of the ed25519 kernel.
+
+Plugs into the batch-verification seam (crypto.set_batch_verifier) that the
+primary's certificate path and the worker's batch path call — the TPU-era
+`TpuVerifier` service of SURVEY §7.8a. Responsibilities:
+
+- host prechecks the kernel doesn't do: length, canonical S (< L), canonical
+  R/A encodings (y < p);
+- the SHA-512 challenge k = H(R || A || M) mod L (hashlib is C-speed; the
+  device only sees 256-bit scalars as 4-bit window digits);
+- shape bucketing: pad each call to the next power-of-two batch so XLA
+  compiles a handful of programs, not one per batch size;
+- CPU fallback when no device kernel is usable (import or platform failure).
+
+An async coalescing front (`AsyncVerifierPool`) batches concurrent requests
+with a size-or-deadline window, the BatchMaker pattern applied to crypto
+(SURVEY §7 "hard parts": offload must be batched or it adds latency).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+from typing import Sequence
+
+import numpy as np
+
+from ..crypto import BatchItem
+
+logger = logging.getLogger("narwhal.tpu.verifier")
+
+_MIN_BUCKET = 16
+_MAX_BUCKET = 4096
+
+
+class TpuVerifier:
+    """Synchronous batch verifier backed by the JAX kernel."""
+
+    def __init__(self, max_bucket: int = _MAX_BUCKET):
+        from . import ed25519 as kernel  # deferred: imports jax
+
+        self.kernel = kernel
+        self.max_bucket = max_bucket
+
+    def precompile(self, sizes: Sequence[int] = ()) -> None:
+        """Warm the jit cache for the given bucket sizes."""
+        from ..crypto import KeyPair
+
+        kp = KeyPair.generate()
+        sig = kp.sign(b"warmup")
+        for size in sizes or (_MIN_BUCKET, self.max_bucket):
+            self([(kp.public, b"warmup", sig)] * size)
+
+    def __call__(self, items: Sequence[BatchItem]) -> list[bool]:
+        n = len(items)
+        if n == 0:
+            return []
+        ok = np.zeros(n, bool)
+        a_raw = np.zeros((n, 32), np.uint8)
+        r_raw = np.zeros((n, 32), np.uint8)
+        s_raw = np.zeros((n, 32), np.uint8)
+        k_raw = np.zeros((n, 32), np.uint8)
+        precheck = np.zeros(n, bool)
+        for i, (pk, msg, sig) in enumerate(items):
+            if len(pk) != 32 or len(sig) != 64:
+                continue
+            rs, sb = sig[:32], sig[32:]
+            s_int = int.from_bytes(sb, "little")
+            if s_int >= self.kernel.ref.L:
+                continue
+            if (int.from_bytes(pk, "little") & ((1 << 255) - 1)) >= self.kernel.ref.P:
+                continue
+            if (int.from_bytes(rs, "little") & ((1 << 255) - 1)) >= self.kernel.ref.P:
+                continue
+            k_int = int.from_bytes(
+                hashlib.sha512(rs + pk + msg).digest(), "little"
+            ) % self.kernel.ref.L
+            a_raw[i] = np.frombuffer(pk, np.uint8)
+            r_raw[i] = np.frombuffer(rs, np.uint8)
+            s_raw[i] = np.frombuffer(sb, np.uint8)
+            k_raw[i] = np.frombuffer(k_int.to_bytes(32, "little"), np.uint8)
+            precheck[i] = True
+
+        idx = np.flatnonzero(precheck)
+        if idx.size == 0:
+            return ok.tolist()
+
+        a_y = self.kernel.bytes_to_limbs(a_raw[idx])
+        r_y = self.kernel.bytes_to_limbs(r_raw[idx])
+        a_sign = (a_raw[idx, 31] >> 7).astype(np.int32)
+        r_sign = (r_raw[idx, 31] >> 7).astype(np.int32)
+        k_digits = self.kernel.bytes_to_digits(k_raw[idx])
+        s_digits = self.kernel.bytes_to_digits(s_raw[idx])
+
+        results = np.zeros(idx.size, bool)
+        for lo in range(0, idx.size, self.max_bucket):
+            hi = min(lo + self.max_bucket, idx.size)
+            bucket = _MIN_BUCKET
+            while bucket < hi - lo:
+                bucket *= 2
+            pad = bucket - (hi - lo)
+
+            def pad_to(arr):
+                if pad == 0:
+                    return arr[lo:hi]
+                return np.concatenate(
+                    [arr[lo:hi], np.repeat(arr[lo : lo + 1], pad, axis=0)]
+                )
+
+            out = self.kernel.verify_batch_kernel(
+                pad_to(a_y),
+                pad_to(a_sign),
+                pad_to(r_y),
+                pad_to(r_sign),
+                pad_to(k_digits),
+                pad_to(s_digits),
+            )
+            results[lo:hi] = np.asarray(out)[: hi - lo]
+        ok[idx] = results
+        return ok.tolist()
+
+
+def make_batch_verifier(fallback_on_error: bool = True):
+    """Build a crypto.BatchVerifier backed by the TPU kernel, falling back to
+    the host loop if the device path fails."""
+    from .. import crypto
+
+    try:
+        verifier = TpuVerifier()
+    except Exception:  # jax/platform import failure
+        logger.exception("TPU verifier unavailable; using host verification")
+        return None
+
+    def backend(items: Sequence[BatchItem]) -> list[bool]:
+        try:
+            return verifier(items)
+        except Exception:
+            if not fallback_on_error:
+                raise
+            logger.exception("TPU verify dispatch failed; host fallback")
+            return crypto._host_batch_verify(items)
+
+    return backend
+
+
+class AsyncVerifierPool:
+    """Size-or-deadline coalescing of concurrent verification requests.
+
+    await pool.verify(pk, msg, sig) from any task; items are flushed to the
+    backend in one batch when `max_batch` are waiting or `max_delay` elapsed
+    since the first queued item (BatchMaker's seal rule, applied to crypto).
+    The backend call runs in a thread so the event loop never blocks on the
+    device.
+    """
+
+    def __init__(
+        self,
+        backend=None,
+        max_batch: int = 512,
+        max_delay: float = 0.002,
+    ):
+        from .. import crypto
+
+        self.backend = backend or crypto.batch_verify
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._pending: list[tuple[BatchItem, asyncio.Future]] = []
+        self._flusher: asyncio.Task | None = None
+        self._batches: set[asyncio.Task] = set()  # strong refs: loop holds weak
+
+    async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append(((public_key, message, signature), fut))
+        if len(self._pending) >= self.max_batch:
+            self._flush_now()
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.ensure_future(self._deadline_flush())
+        return await fut
+
+    def _flush_now(self) -> None:
+        pending, self._pending = self._pending, []
+        if pending:
+            task = asyncio.ensure_future(self._run_batch(pending))
+            self._batches.add(task)
+            task.add_done_callback(self._batches.discard)
+
+    async def _deadline_flush(self) -> None:
+        await asyncio.sleep(self.max_delay)
+        self._flush_now()
+
+    async def _run_batch(self, pending) -> None:
+        items = [item for item, _ in pending]
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(None, self.backend, items)
+        except Exception as e:
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), res in zip(pending, results):
+            if not fut.done():
+                fut.set_result(res)
+
+    async def close(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+        self._flush_now()

@@ -1,8 +1,10 @@
 import os
 
 # Tests exercise multi-device sharding on a virtual 8-device CPU mesh; the
-# real TPU chip is reserved for bench.py. Must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# real TPU chip is reserved for bench.py. JAX_PLATFORMS alone does not win
+# against an already-registered accelerator plugin (the environment presets
+# JAX_PLATFORMS=axon), so also pin jax_default_device to CPU below.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,6 +14,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import asyncio
 
 import pytest
+
+
+def pytest_configure(config):
+    import jax
+
+    if jax.default_backend() != "cpu":
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
 @pytest.fixture

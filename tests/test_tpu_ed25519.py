@@ -1,0 +1,138 @@
+"""TPU ed25519 kernel: field/point correctness vs the integer reference, and
+end-to-end batch verification equivalence with the host library (the
+fastcrypto-trait seam, SURVEY §2.3)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from narwhal_tpu.crypto import KeyPair, verify as host_verify
+from narwhal_tpu.tpu import ed25519 as k
+from narwhal_tpu.tpu import ed25519_ref as ref
+from narwhal_tpu.tpu.verifier import TpuVerifier
+
+
+def test_field_ops_match_bigint():
+    rng = random.Random(1)
+    import jax
+
+    mul = jax.jit(k.fe_mul)
+    add = jax.jit(k.fe_add)
+    sub = jax.jit(k.fe_sub)
+    inv = jax.jit(k.fe_invert)
+    for _ in range(20):
+        a, b = rng.randrange(ref.P), rng.randrange(ref.P)
+        la, lb = k.int_to_limbs(a), k.int_to_limbs(b)
+        assert k.limbs_to_int(mul(la, lb)) % ref.P == a * b % ref.P
+        assert k.limbs_to_int(add(la, lb)) % ref.P == (a + b) % ref.P
+        assert k.limbs_to_int(sub(la, lb)) % ref.P == (a - b) % ref.P
+    a = rng.randrange(1, ref.P)
+    assert k.limbs_to_int(inv(k.int_to_limbs(a))) % ref.P == pow(a, ref.P - 2, ref.P)
+    # canonicalization handles values in [p, 2p)
+    assert k.limbs_to_int(k.fe_canonical(k.int_to_limbs(ref.P + 5))) == 5
+
+
+def test_point_ops_match_reference():
+    import jax.numpy as jnp
+
+    def to_ext(p):
+        return jnp.asarray(np.stack([k.int_to_limbs(c) for c in p]))
+
+    def from_ext(e):
+        return tuple(k.limbs_to_int(k.fe_canonical(e[i])) for i in range(4))
+
+    p1 = ref.point_mul(987654321, ref.G)
+    p2 = ref.point_mul(123456789, ref.G)
+    assert ref.point_equal(from_ext(k.pt_add(to_ext(p1), to_ext(p2))), ref.point_add(p1, p2))
+    assert ref.point_equal(from_ext(k.pt_double(to_ext(p1))), ref.point_double(p1))
+    assert ref.point_equal(from_ext(k.pt_add(to_ext(ref.IDENTITY), to_ext(p1))), p1)
+    assert ref.point_equal(from_ext(k.pt_add(to_ext(p1), to_ext(ref.point_neg(p1)))), ref.IDENTITY)
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return TpuVerifier()
+
+
+def test_batch_verify_valid_and_corrupted(verifier):
+    rng = random.Random(2)
+    keys = [KeyPair.generate() for _ in range(8)]
+    items = []
+    expected = []
+    for i in range(40):
+        kp = keys[i % len(keys)]
+        msg = bytes([i]) * (1 + i % 17)
+        sig = kp.sign(msg)
+        kind = i % 5
+        if kind == 0:
+            items.append((kp.public, msg, sig))
+            expected.append(True)
+        elif kind == 1:  # corrupt signature R
+            bad = bytearray(sig)
+            bad[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            items.append((kp.public, msg, bytes(bad)))
+            expected.append(False)
+        elif kind == 2:  # corrupt signature S
+            bad = bytearray(sig)
+            bad[32 + rng.randrange(31)] ^= 1 << rng.randrange(8)
+            items.append((kp.public, msg, bytes(bad)))
+            expected.append(False)
+        elif kind == 3:  # wrong message
+            items.append((kp.public, msg + b"!", sig))
+            expected.append(False)
+        else:  # wrong key
+            items.append((keys[(i + 1) % len(keys)].public, msg, sig))
+            expected.append(False)
+    got = verifier(items)
+    assert got == expected
+    assert got == [host_verify(pk, m, s) for pk, m, s in items]
+
+
+def test_batch_verify_malformed_inputs(verifier):
+    kp = KeyPair.generate()
+    sig = kp.sign(b"x")
+    high_s = sig[:32] + (ref.L + 1).to_bytes(32, "little")
+    noncanon_r = (ref.P + 3).to_bytes(32, "little") + sig[32:]
+    items = [
+        (kp.public, b"x", b"short"),
+        (b"\x00" * 31, b"x", sig),
+        (kp.public, b"x", high_s),
+        (kp.public, b"x", noncanon_r),
+        (b"\xff" * 32, b"x", sig),  # y >= p: non-canonical pubkey
+        (kp.public, b"x", sig),
+    ]
+    assert verifier(items) == [False, False, False, False, False, True]
+
+
+def test_batch_verify_odd_sizes(verifier):
+    kp = KeyPair.generate()
+    for n in (1, 3, 17):
+        items = [(kp.public, bytes([j]), kp.sign(bytes([j]))) for j in range(n)]
+        assert verifier(items) == [True] * n
+
+
+def test_async_pool_coalesces():
+    import asyncio
+
+    from narwhal_tpu.tpu.verifier import AsyncVerifierPool
+
+    calls = []
+
+    def backend(items):
+        calls.append(len(items))
+        from narwhal_tpu.crypto import _host_batch_verify
+
+        return _host_batch_verify(items)
+
+    async def scenario():
+        pool = AsyncVerifierPool(backend=backend, max_batch=8, max_delay=0.01)
+        kp = KeyPair.generate()
+        sigs = [(kp.public, bytes([i]), kp.sign(bytes([i]))) for i in range(8)]
+        results = await asyncio.gather(*(pool.verify(*item) for item in sigs))
+        assert all(results)
+        assert not await pool.verify(kp.public, b"other", sigs[0][2])
+        await pool.close()
+
+    asyncio.run(scenario())
+    assert calls[0] == 8  # first batch flushed by size, not per item
