@@ -495,3 +495,203 @@ class SubmitTransactionStreamMsg:
     @staticmethod
     def decode(r: Reader) -> "SubmitTransactionStreamMsg":
         return SubmitTransactionStreamMsg(tuple(r.seq(lambda r_: r_.bytes())))
+
+
+# ---------------------------------------------------------------------------
+# Public consensus API (the tonic Validator / Proposer / Configuration
+# services, /root/reference/types/proto/narwhal.proto:127-152 served by
+# primary/src/grpc_server/). "Collection" = a certificate's payload.
+# ---------------------------------------------------------------------------
+
+
+@message(60)
+@dataclass
+class GetCollectionsRequest:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "GetCollectionsRequest":
+        return GetCollectionsRequest(tuple(r.seq(_dec_digest)))
+
+
+@message(61)
+@dataclass
+class GetCollectionsResponse:
+    """Per requested digest: (digest, batches, error). `batches` is a tuple
+    of (batch_digest, transactions); `error` is "" on success."""
+
+    results: tuple[tuple[Digest, tuple[tuple[Digest, tuple[bytes, ...]], ...], str], ...]
+
+    def encode(self, w: Writer) -> None:
+        def enc_batch(w_: Writer, item) -> None:
+            _enc_digest(w_, item[0])
+            w_.seq(item[1], lambda w2, t: w2.bytes(t))
+
+        def enc(w_: Writer, item) -> None:
+            _enc_digest(w_, item[0])
+            w_.seq(item[1], enc_batch)
+            w_.bytes(item[2].encode())
+
+        w.seq(self.results, enc)
+
+    @staticmethod
+    def decode(r: Reader) -> "GetCollectionsResponse":
+        def dec_batch(r_: Reader):
+            return (_dec_digest(r_), tuple(r_.seq(lambda r2: r2.bytes())))
+
+        def dec(r_: Reader):
+            return (
+                _dec_digest(r_),
+                tuple(r_.seq(dec_batch)),
+                r_.bytes().decode(),
+            )
+
+        return GetCollectionsResponse(tuple(r.seq(dec)))
+
+
+@message(62)
+@dataclass
+class RemoveCollectionsRequest:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "RemoveCollectionsRequest":
+        return RemoveCollectionsRequest(tuple(r.seq(_dec_digest)))
+
+
+@message(63)
+@dataclass
+class ReadCausalRequest:
+    digest: Digest
+
+    def encode(self, w: Writer) -> None:
+        _enc_digest(w, self.digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "ReadCausalRequest":
+        return ReadCausalRequest(_dec_digest(r))
+
+
+@message(64)
+@dataclass
+class ReadCausalResponse:
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "ReadCausalResponse":
+        return ReadCausalResponse(tuple(r.seq(_dec_digest)))
+
+
+@message(65)
+@dataclass
+class RoundsRequest:
+    public_key: PublicKey
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.public_key)
+
+    @staticmethod
+    def decode(r: Reader) -> "RoundsRequest":
+        return RoundsRequest(r.raw(PUBLIC_KEY_LEN))
+
+
+@message(66)
+@dataclass
+class RoundsResponse:
+    oldest_round: Round
+    newest_round: Round
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.oldest_round)
+        w.u64(self.newest_round)
+
+    @staticmethod
+    def decode(r: Reader) -> "RoundsResponse":
+        return RoundsResponse(r.u64(), r.u64())
+
+
+@message(67)
+@dataclass
+class NodeReadCausalRequest:
+    public_key: PublicKey
+    round: Round
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.public_key)
+        w.u64(self.round)
+
+    @staticmethod
+    def decode(r: Reader) -> "NodeReadCausalRequest":
+        return NodeReadCausalRequest(r.raw(PUBLIC_KEY_LEN), r.u64())
+
+
+@message(68)
+@dataclass
+class NewNetworkInfoRequest:
+    """(epoch, [(public_key, stake, primary_address)])."""
+
+    epoch: int
+    validators: tuple[tuple[PublicKey, int, str], ...]
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.epoch)
+
+        def enc(w_: Writer, item) -> None:
+            w_.raw(item[0])
+            w_.u64(item[1])
+            w_.bytes(item[2].encode())
+
+        w.seq(self.validators, enc)
+
+    @staticmethod
+    def decode(r: Reader) -> "NewNetworkInfoRequest":
+        def dec(r_: Reader):
+            return (r_.raw(PUBLIC_KEY_LEN), r_.u64(), r_.bytes().decode())
+
+        return NewNetworkInfoRequest(r.u64(), tuple(r.seq(dec)))
+
+
+@message(69)
+@dataclass
+class GetPrimaryAddressRequest:
+    def encode(self, w: Writer) -> None:
+        pass
+
+    @staticmethod
+    def decode(r: Reader) -> "GetPrimaryAddressRequest":
+        return GetPrimaryAddressRequest()
+
+
+@message(70)
+@dataclass
+class GetPrimaryAddressResponse:
+    address: str
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.address.encode())
+
+    @staticmethod
+    def decode(r: Reader) -> "GetPrimaryAddressResponse":
+        return GetPrimaryAddressResponse(r.bytes().decode())
+
+
+@message(71)
+@dataclass
+class NewEpochRequest:
+    epoch: int
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.epoch)
+
+    @staticmethod
+    def decode(r: Reader) -> "NewEpochRequest":
+        return NewEpochRequest(r.u64())

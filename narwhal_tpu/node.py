@@ -26,6 +26,10 @@ from .executor import (
 )
 from .metrics import Registry
 from .primary import NetworkModel, Primary
+from .primary.api_server import ConsensusApi
+from .primary.block_remover import BlockRemover
+from .primary.block_synchronizer import BlockSynchronizer
+from .primary.block_waiter import BlockWaiter
 from .stores import NodeStorage
 from .types import ConsensusOutput, PublicKey
 from .worker import Worker
@@ -141,6 +145,42 @@ class PrimaryNode:
             # External consensus: the Dag service consumes the certificate
             # stream and serves causal queries (node/src/lib.rs:198-213).
             self.dag = Dag(committee, self.tx_new_certificates)
+
+        # Block services + the public consensus API (primary/src/grpc_server).
+        self.block_synchronizer = BlockSynchronizer(
+            self.name,
+            committee,
+            worker_cache,
+            storage.certificate_store,
+            storage.payload_store,
+            self.primary.network,
+            parameters,
+            tx_loopback=self.primary.tx_primary_messages,
+        )
+        self.block_waiter = BlockWaiter(
+            self.name,
+            worker_cache,
+            storage.certificate_store,
+            self.primary.network,
+            self.block_synchronizer,
+        )
+        self.block_remover = BlockRemover(
+            self.name,
+            worker_cache,
+            storage.certificate_store,
+            storage.header_store,
+            storage.payload_store,
+            self.primary.network,
+            dag=self.dag,
+        )
+        self.api = ConsensusApi(
+            self.name,
+            committee,
+            self.block_waiter,
+            self.block_remover,
+            dag=self.dag,
+        )
+        self.api_address: str = ""
         self._tasks: list[asyncio.Task] = []
 
     @property
@@ -164,11 +204,16 @@ class PrimaryNode:
             self._tasks.extend(await self.executor.spawn(restored))
         if self.dag is not None:
             self._tasks.append(self.dag.spawn())
+        self.api.primary_address = self.primary.address
+        self.api_address = await self.api.spawn(
+            self.parameters.consensus_api_grpc_address
+        )
 
     async def shutdown(self) -> None:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
+        await self.api.shutdown()
         await self.primary.shutdown()
         self.storage.close()
 

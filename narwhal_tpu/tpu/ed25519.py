@@ -74,49 +74,61 @@ for _d, (_x, _y, _t) in enumerate(ref.base_window_table()):
 
 
 # ---------------------------------------------------------------------------
-# Field element ops. A field element is an int32[NLIMB] array; all functions
-# keep limbs in [0, 2^13) ("reduced form", value possibly in [p, 2p)).
+# Field element ops. A field element is an int32[NLIMB] array in LOOSE form:
+# limbs in [0, LOOSE] with LOOSE = 9500 (value may exceed 2^255; only
+# congruence mod p is maintained). Carries are propagated by PARALLEL rounds
+# (vector shift/mask/add, no 20-step sequential chain): one round moves every
+# limb's overflow one position up at once, and the bounds below prove a fixed
+# small number of rounds restores the loose invariant. This keeps the XLA
+# graph small and the dependency chains short — the whole multiplier is ~50
+# vector ops on int32 lanes.
+#
+# Bound bookkeeping (documented invariants, all < 2^31):
+#   mul columns: 20 * LOOSE^2 = 1.805e9          (inputs loose)
+#   mul fold:    col + 608*8191 + 608*(col>>13) <= 1.94e9
+#   mul: 4 carry rounds -> limbs <= ~8800
+#   add: inputs loose -> sum <= 19000, 2 rounds -> <= 9409
+#   sub: a + 64p - b with 64p = [15168, 16382 x19] (all limbs >= 15168, so
+#        every limb difference stays positive), 3 rounds -> <= ~8801
 # ---------------------------------------------------------------------------
 
-
-def _carry_chain(c, n):
-    """Sequential carry propagation over n limbs; returns (limbs, overflow)."""
-    outs = []
-    carry = jnp.zeros_like(c[..., 0])
-    for i in range(n):
-        v = c[..., i] + carry
-        outs.append(v & MASK)
-        carry = v >> RADIX
-    return jnp.stack(outs, axis=-1), carry
+LOOSE = 9500
 
 
-def _fold255(r, overflow):
-    """Fold bits >= 255 (limb 19 bits 8+, plus any limb-20 overflow) back in
-    with weight 19, then one more carry pass."""
-    top = r[..., NLIMB - 1]
-    hi = (top >> 8) + (overflow << (RADIX - 8))
-    r = r.at[..., NLIMB - 1].set(top & 0xFF)
-    r = r.at[..., 0].add(19 * hi)
-    r, _ = _carry_chain(r, NLIMB)
-    return r
-
-
-def fe_reduce(r):
-    """Reduce an int32[NLIMB] with limbs < ~2^30 to reduced form."""
-    r, overflow = _carry_chain(r, NLIMB)
-    return _fold255(r, overflow)
+def _carry_round(r):
+    """One parallel carry round over NLIMB limbs; limb-19 overflow (weight
+    2^260 == 608 mod p) folds into limb 0."""
+    hi = r >> RADIX
+    lo = r & MASK
+    up = jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+    return lo + up + 608 * jnp.where(
+        jnp.arange(NLIMB) == 0, hi[..., NLIMB - 1 : NLIMB], 0
+    )
 
 
 def fe_add(a, b):
-    return fe_reduce(a + b)
+    r = a + b
+    r = _carry_round(r)
+    return _carry_round(r)
+
+
+# 64p = 2^261 - 1216 expressed with every limb large (>= 15168): per-limb
+# subtraction below never goes negative for loose inputs.
+_SUB_BIAS = np.array([15168] + [16382] * (NLIMB - 1), np.int32)
+assert limbs_to_int(_SUB_BIAS) == 64 * ref.P
 
 
 def fe_sub(a, b):
-    return fe_reduce(a + jnp.asarray(_2P_LIMBS) - b)
+    r = a + jnp.asarray(_SUB_BIAS) - b
+    r = _carry_round(r)
+    r = _carry_round(r)
+    return _carry_round(r)
 
 
 def fe_neg(a):
-    return fe_reduce(jnp.asarray(_2P_LIMBS) - a)
+    r = jnp.asarray(_SUB_BIAS) - a
+    r = _carry_round(r)
+    return _carry_round(r)
 
 
 def fe_mul(a, b):
@@ -124,20 +136,47 @@ def fe_mul(a, b):
     c = jnp.zeros(a.shape[:-1] + (2 * NLIMB,), jnp.int32)
     for i in range(NLIMB):
         c = c.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
-    c, _ = _carry_chain(c, 2 * NLIMB)  # no carry out of limb 39: c_38 < 2^31
-    # 2^260 == 19 * 2^5 == 608 (mod p): fold the high half down.
-    r = c[..., :NLIMB] + 608 * c[..., NLIMB:]
-    r, overflow = _carry_chain(r, NLIMB)
-    return _fold255(r, overflow)
+    # Fold the high half down (2^260 == 608 mod p) without carrying the raw
+    # columns first: split each high column into 13-bit lo + hi so that
+    # 608*hi rides one limb up and nothing overflows int32 (c_39 == 0, so
+    # the shifted d_hi never spills past limb 19).
+    c_lo, c_hi = c[..., :NLIMB], c[..., NLIMB:]
+    d_lo = c_hi & MASK
+    d_hi = c_hi >> RADIX
+    up = jnp.concatenate([jnp.zeros_like(d_hi[..., :1]), d_hi[..., :-1]], axis=-1)
+    r = c_lo + 608 * d_lo + 608 * up
+    for _ in range(4):
+        r = _carry_round(r)
+    return r
 
 
 def fe_sq(a):
     return fe_mul(a, a)
 
 
+def _carry_chain_exact(r):
+    """Sequential full carry (canonicalization only — not on the hot path)."""
+    outs = []
+    carry = jnp.zeros_like(r[..., 0])
+    for i in range(NLIMB):
+        v = r[..., i] + carry
+        outs.append(v & MASK)
+        carry = v >> RADIX
+    return jnp.stack(outs, axis=-1), carry
+
+
 def fe_canonical(a):
-    """Full reduction to [0, p): conditionally subtract p twice."""
+    """Full reduction to [0, p) from loose form."""
     for _ in range(2):
+        a, overflow = _carry_chain_exact(a)
+        # Fold bits >= 255: limb 19 keeps its low 8 bits, the rest (plus the
+        # 2^260-weight overflow) re-enters with weight 19.
+        top = a[..., NLIMB - 1]
+        hi = (top >> 8) + (overflow << (RADIX - 8))
+        a = a.at[..., NLIMB - 1].set(top & 0xFF)
+        a = a.at[..., 0].add(19 * hi)
+    a, _ = _carry_chain_exact(a)
+    for _ in range(2):  # value now < 2^255 + eps: conditionally subtract p
         borrow = jnp.zeros_like(a[..., 0])
         outs = []
         for i in range(NLIMB):

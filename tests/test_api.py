@@ -1,0 +1,133 @@
+"""Public consensus API integration tests: spawn real nodes in external-
+consensus mode and exercise Validator/Proposer/Configuration end-to-end.
+
+Mirrors /root/reference/primary/tests/integration_tests_{validator,proposer,
+configuration}_api.rs (collections fetch/removal, rounds, node_read_causal,
+network info updates)."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.cluster import Cluster
+from narwhal_tpu.messages import (
+    GetCollectionsRequest,
+    GetPrimaryAddressRequest,
+    NewEpochRequest,
+    NewNetworkInfoRequest,
+    NodeReadCausalRequest,
+    ReadCausalRequest,
+    RemoveCollectionsRequest,
+    RoundsRequest,
+    SubmitTransactionStreamMsg,
+)
+from narwhal_tpu.network import NetworkClient, RpcError
+
+
+async def _api_cluster():
+    cluster = Cluster(size=4, workers=1, internal_consensus=False)
+    await cluster.start()
+    client = NetworkClient()
+    # Drive some load so headers carry payload.
+    target = cluster.authorities[0].worker_transactions_address(0)
+    txs = tuple(bytes([7]) * 32 + bytes([i]) for i in range(32))
+    await client.request(target, SubmitTransactionStreamMsg(txs))
+    return cluster, client
+
+
+async def _wait_rounds(client, api, pk, minimum, timeout=30.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        try:
+            resp = await client.request(api, RoundsRequest(pk))
+            if resp.newest_round >= minimum:
+                return resp
+        except RpcError:
+            pass
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(f"rounds never reached {minimum}")
+        await asyncio.sleep(0.2)
+
+
+def test_proposer_and_validator_api(run):
+    async def scenario():
+        cluster, client = await _api_cluster()
+        try:
+            node = cluster.authorities[0]
+            api = node.primary.api_address
+            pk = node.name
+
+            rounds = await _wait_rounds(client, api, pk, 2)
+            assert rounds.oldest_round <= rounds.newest_round
+
+            # NodeReadCausal at the newest round -> causal collection ids.
+            nrc = await client.request(
+                api, NodeReadCausalRequest(pk, rounds.newest_round)
+            )
+            assert len(nrc.digests) >= 1
+
+            # ReadCausal from the same start.
+            rc = await client.request(api, ReadCausalRequest(nrc.digests[0]))
+            assert set(rc.digests) == set(nrc.digests)
+
+            # GetCollections over the walked ids: every result resolves
+            # (payload batches or an explicit per-collection error).
+            got = await client.request(api, GetCollectionsRequest(nrc.digests))
+            assert len(got.results) == len(nrc.digests)
+            ok = [r for r in got.results if r[2] == ""]
+            assert ok, f"no collection resolved: {[r[2] for r in got.results]}"
+            assert any(batches for _, batches, _ in ok)
+
+            # RemoveCollections of everything fetched succeeds (Empty/Ack).
+            await client.request(
+                api, RemoveCollectionsRequest(tuple(d for d, _, _ in got.results))
+            )
+            # Removed collections no longer resolve locally.
+            again = await client.request(
+                api, GetCollectionsRequest((got.results[0][0],))
+            )
+            assert again.results[0][2] != "" or not again.results[0][1]
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
+
+
+def test_configuration_api(run):
+    async def scenario():
+        cluster, client = await _api_cluster()
+        try:
+            node = cluster.authorities[0]
+            api = node.primary.api_address
+
+            addr = await client.request(api, GetPrimaryAddressRequest())
+            assert addr.address == node.primary.address
+
+            with pytest.raises(RpcError, match="Not Implemented"):
+                await client.request(api, NewEpochRequest(1))
+
+            # Wrong epoch is rejected.
+            validators = tuple(
+                (pk, a.stake, a.primary_address)
+                for pk, a in cluster.committee.authorities.items()
+            )
+            with pytest.raises(RpcError, match="does not match current epoch"):
+                await client.request(api, NewNetworkInfoRequest(7, validators))
+
+            # Correct epoch with identical info is accepted.
+            await client.request(
+                api, NewNetworkInfoRequest(cluster.committee.epoch, validators)
+            )
+
+            # Unknown key in the update is rejected.
+            bad = ((b"\x05" * 32, 1, "127.0.0.1:1"),) + validators[1:]
+            with pytest.raises(RpcError, match="unknown authority"):
+                await client.request(
+                    api, NewNetworkInfoRequest(cluster.committee.epoch, bad)
+                )
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
