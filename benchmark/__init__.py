@@ -1,0 +1,6 @@
+"""Benchmark harness: local cluster orchestration + log-derived metrics.
+
+Reference design: /root/reference/benchmark/ (fabfile tasks, LocalBench,
+LogParser). The measurement plane is structured log lines, identical in
+spirit to the reference's `benchmark` feature logs.
+"""

@@ -1,0 +1,37 @@
+"""CLI for the local benchmark (the reference's `fab local`):
+
+    python -m benchmark --nodes 4 --workers 1 --rate 1000 --duration 20
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .local import BenchParameters, LocalBench
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--rate", type=int, default=1_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=20)
+    ap.add_argument("--faults", type=int, default=0)
+    args = ap.parse_args()
+
+    bench = LocalBench(
+        BenchParameters(
+            nodes=args.nodes,
+            workers=args.workers,
+            rate=args.rate,
+            tx_size=args.tx_size,
+            duration=args.duration,
+            faults=args.faults,
+        )
+    )
+    print(bench.run().result())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""LocalBench: boot a real multi-process committee on localhost and measure.
+
+Reference: /root/reference/benchmark/benchmark/local.py — generates keys and
+committee files, spawns every primary/worker as its own OS process (tmux
+there, subprocess here; each `python -m narwhal_tpu run ...` is the same
+single-role binary shape as the reference's `node run`), injects load with
+benchmark clients, then parses the logs. `faults: f` leaves the last f nodes
+unbooted (the reference's only fault-injection mechanism).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu.config import (
+    Authority,
+    Committee,
+    Parameters,
+    WorkerCache,
+    WorkerInfo,
+    get_available_port,
+)
+from narwhal_tpu.crypto import KeyPair
+
+from .logs import LogParser
+
+
+@dataclass
+class BenchParameters:
+    nodes: int = 4
+    workers: int = 1
+    rate: int = 1_000
+    tx_size: int = 512
+    duration: int = 20
+    faults: int = 0
+
+
+class LocalBench:
+    def __init__(self, bench: BenchParameters, node_parameters: Parameters | None = None):
+        self.bench = bench
+        self.node_parameters = node_parameters or Parameters(
+            max_header_delay=0.1, max_batch_delay=0.1
+        )
+        self.base = os.path.abspath(".bench")
+        self.procs: list[subprocess.Popen] = []
+
+    # -- config generation (local.py + config.py of the reference) ---------
+
+    def _generate_configs(self):
+        shutil.rmtree(self.base, ignore_errors=True)
+        os.makedirs(self.base)
+        keypairs = [KeyPair.generate() for _ in range(self.bench.nodes)]
+        authorities = {}
+        workers = {}
+        for i, kp in enumerate(keypairs):
+            with open(f"{self.base}/key-{i}.json", "w") as f:
+                json.dump(
+                    {"name": kp.public.hex(), "seed": kp.private_bytes().hex()}, f
+                )
+            authorities[kp.public] = Authority(
+                stake=1,
+                primary_address=f"127.0.0.1:{get_available_port()}",
+                network_key=kp.public,
+            )
+            workers[kp.public] = {
+                wid: WorkerInfo(
+                    name=kp.public,
+                    transactions=f"127.0.0.1:{get_available_port()}",
+                    worker_address=f"127.0.0.1:{get_available_port()}",
+                )
+                for wid in range(self.bench.workers)
+            }
+        committee = Committee(authorities)
+        committee.export(f"{self.base}/committee.json")
+        WorkerCache(workers).export(f"{self.base}/workers.json")
+        self.node_parameters.export(f"{self.base}/parameters.json")
+        return committee, workers
+
+    # -- process control ---------------------------------------------------
+
+    def _spawn(self, argv: list[str], log_path: str) -> None:
+        log = open(log_path, "w")
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(self.base) or ".")
+        self.procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "narwhal_tpu", "-v", *argv],
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)) + "/..",
+            )
+        )
+
+    def _kill_all(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in self.procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+
+    def run(self, debug: bool = False) -> LogParser:
+        bench = self.bench
+        committee, workers = self._generate_configs()
+        alive = bench.nodes - bench.faults
+        keys = list(committee.authorities)
+        common = [
+            "--committee", f"{self.base}/committee.json",
+            "--workers", f"{self.base}/workers.json",
+            "--parameters", f"{self.base}/parameters.json",
+        ]
+        try:
+            for i in range(alive):
+                self._spawn(
+                    ["run", "--keys", f"{self.base}/key-{i}.json", *common,
+                     "--store", f"{self.base}/db-{i}", "primary"],
+                    f"{self.base}/primary-{i}.log",
+                )
+                for wid in range(bench.workers):
+                    self._spawn(
+                        ["run", "--keys", f"{self.base}/key-{i}.json", *common,
+                         "--store", f"{self.base}/db-{i}", "worker", "--id", str(wid)],
+                        f"{self.base}/worker-{i}-{wid}.log",
+                    )
+            # One client per alive worker lane (local.py: rate share).
+            lanes = [
+                workers[keys[i]][wid].transactions
+                for i in range(alive)
+                for wid in range(bench.workers)
+            ]
+            share = max(1, bench.rate // len(lanes))
+            for j, target in enumerate(lanes):
+                self._spawn(
+                    ["benchmark_client", "--target", target,
+                     "--rate", str(share), "--size", str(bench.tx_size),
+                     "--nodes", *lanes],
+                    f"{self.base}/client-{j}.log",
+                )
+            time.sleep(bench.duration)
+        finally:
+            self._kill_all()
+        return LogParser.process(self.base, faults=bench.faults)

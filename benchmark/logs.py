@@ -1,0 +1,177 @@
+"""LogParser: compute TPS / latency from node and client logs.
+
+Reference: /root/reference/benchmark/benchmark/logs.py:171-244. Metrics:
+
+- consensus TPS/BPS: committed batch bytes over [first proposal, last commit]
+- consensus latency: commit time - proposal time, per batch digest
+- end-to-end TPS: same bytes over [first client send, last commit]
+- end-to-end latency: commit time of the batch containing a sample tx minus
+  the client's send time for that sample
+
+Log lines parsed (all emitted by the framework under normal INFO logging):
+  primary:  "Created B<round>(<header>) -> <batch>"
+            "Committed B<round>(<header>) -> <batch>"
+  worker:   "Batch <digest> contains <n> B"
+            "Batch <digest> contains sample tx <id>"
+  client:   "Sending sample transaction <id>"
+            "Transactions size: <n> B" / "Transactions rate: <n> tx/s"
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from datetime import datetime, timezone
+from re import findall, search
+from statistics import mean
+
+
+class ParseError(Exception):
+    pass
+
+
+def _ts(stamp: str) -> float:
+    return (
+        datetime.strptime(stamp, "%Y-%m-%dT%H:%M:%S.%f")
+        .replace(tzinfo=timezone.utc)
+        .timestamp()
+    )
+
+
+class LogParser:
+    def __init__(
+        self,
+        clients: list[str],
+        primaries: list[str],
+        workers: list[str],
+        faults: int = 0,
+    ):
+        self.faults = faults
+        self.committee_size = len(primaries) + faults
+        self.workers_per_node = len(workers) // max(len(primaries), 1)
+
+        # -- clients ------------------------------------------------------
+        self.size = 512
+        self.rate = []
+        self.start: list[float] = []
+        self.sent_samples: list[dict[int, float]] = []
+        for log in clients:
+            if search(r"Error", log) is not None:
+                raise ParseError("Client(s) panicked")
+            m = search(r"Transactions size: (\d+) B", log)
+            if m:
+                self.size = int(m.group(1))
+            m = search(r"Transactions rate: (\d+) tx/s", log)
+            if m:
+                self.rate.append(int(m.group(1)))
+            m = search(r"(.*?)Z .* Start sending transactions", log)
+            if m:
+                self.start.append(_ts(m.group(1)))
+            samples = findall(r"(.*?)Z .* Sending sample transaction (\d+)", log)
+            self.sent_samples.append({int(i): _ts(t) for t, i in samples})
+
+        # -- primaries ----------------------------------------------------
+        proposals: dict[str, float] = {}
+        commits: dict[str, float] = {}
+        for log in primaries:
+            if search(r"ERROR|CRITICAL|Traceback", log) is not None:
+                raise ParseError("Primary(s) panicked")
+            for t, d in findall(r"(.*?)Z .* Created B\d+\([0-9a-f]+\) -> ([0-9a-f]+)", log):
+                ts = _ts(t)
+                if d not in proposals or ts < proposals[d]:
+                    proposals[d] = ts
+            for t, d in findall(r"(.*?)Z .* Committed B\d+\([0-9a-f]+\) -> ([0-9a-f]+)", log):
+                ts = _ts(t)
+                if d not in commits or ts < commits[d]:
+                    commits[d] = ts
+        self.proposals = proposals
+        self.commits = {d: t for d, t in commits.items() if d in proposals}
+
+        # -- workers ------------------------------------------------------
+        self.sizes: dict[str, int] = {}
+        self.received_samples: dict[int, str] = {}
+        for log in workers:
+            if search(r"ERROR|CRITICAL|Traceback", log) is not None:
+                raise ParseError("Worker(s) panicked")
+            for d, s in findall(r"Batch ([0-9a-f]+) contains (\d+) B", log):
+                self.sizes[d] = int(s)
+            for d, i in findall(r"Batch ([0-9a-f]+) contains sample tx (\d+)", log):
+                self.received_samples[int(i)] = d
+
+    @classmethod
+    def process(cls, directory: str, faults: int = 0) -> "LogParser":
+        def read(pattern: str) -> list[str]:
+            out = []
+            for path in sorted(glob.glob(os.path.join(directory, pattern))):
+                with open(path, errors="replace") as f:
+                    out.append(f.read())
+            return out
+
+        return cls(
+            read("client-*.log"),
+            read("primary-*.log"),
+            read("worker-*.log"),
+            faults,
+        )
+
+    # -- metrics (logs.py:165-208) ----------------------------------------
+
+    def _committed_bytes(self) -> int:
+        return sum(self.sizes.get(d, 0) for d in self.commits)
+
+    def consensus_throughput(self) -> tuple[float, float, float]:
+        if not self.commits:
+            return 0.0, 0.0, 0.0
+        start, end = min(self.proposals.values()), max(self.commits.values())
+        duration = max(end - start, 1e-9)
+        bps = self._committed_bytes() / duration
+        return bps / self.size, bps, duration
+
+    def consensus_latency(self) -> float:
+        lat = [c - self.proposals[d] for d, c in self.commits.items()]
+        return mean(lat) if lat else 0.0
+
+    def end_to_end_throughput(self) -> tuple[float, float, float]:
+        if not self.commits or not self.start:
+            return 0.0, 0.0, 0.0
+        start, end = min(self.start), max(self.commits.values())
+        duration = max(end - start, 1e-9)
+        bps = self._committed_bytes() / duration
+        return bps / self.size, bps, duration
+
+    def end_to_end_latency(self) -> float:
+        lat = []
+        for sent in self.sent_samples:
+            for tx_id, batch in self.received_samples.items():
+                if batch in self.commits and tx_id in sent:
+                    lat.append(self.commits[batch] - sent[tx_id])
+        return mean(lat) if lat else 0.0
+
+    def result(self) -> str:
+        c_tps, c_bps, duration = self.consensus_throughput()
+        c_lat = self.consensus_latency() * 1_000
+        e_tps, e_bps, _ = self.end_to_end_throughput()
+        e_lat = self.end_to_end_latency() * 1_000
+        return (
+            "\n"
+            "-----------------------------------------\n"
+            " SUMMARY:\n"
+            "-----------------------------------------\n"
+            " + CONFIG:\n"
+            f" Faults: {self.faults} node(s)\n"
+            f" Committee size: {self.committee_size} node(s)\n"
+            f" Worker(s) per node: {self.workers_per_node} worker(s)\n"
+            f" Input rate: {sum(self.rate):,} tx/s\n"
+            f" Transaction size: {self.size:,} B\n"
+            f" Execution time: {round(duration):,} s\n"
+            "\n"
+            " + RESULTS:\n"
+            f" Consensus TPS: {round(c_tps):,} tx/s\n"
+            f" Consensus BPS: {round(c_bps):,} B/s\n"
+            f" Consensus latency: {round(c_lat):,} ms\n"
+            "\n"
+            f" End-to-end TPS: {round(e_tps):,} tx/s\n"
+            f" End-to-end BPS: {round(e_bps):,} B/s\n"
+            f" End-to-end latency: {round(e_lat):,} ms\n"
+            "-----------------------------------------\n"
+        )

@@ -74,6 +74,7 @@ async def _run_node(args) -> None:
             parameters,
             storage,
             internal_consensus=not args.consensus_disabled,
+            crypto_backend=getattr(args, "crypto_backend", "cpu"),
         )
         await node.spawn()
         registry = node.registry
@@ -126,6 +127,11 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument(
         "--consensus-disabled", action="store_true",
         help="external consensus: expose the Dag API instead of Bullshark",
+    )
+    p.add_argument(
+        "--crypto-backend", choices=("cpu", "pool", "tpu"), default="cpu",
+        help="signature verification: inline host (cpu), coalescing host "
+        "pool, or the TPU batch kernel",
     )
     w = rsub.add_parser("worker")
     w.add_argument("--id", type=int, required=True)

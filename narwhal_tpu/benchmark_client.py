@@ -40,6 +40,12 @@ class BenchmarkClient:
         self.counter = 0
         self._task: asyncio.Task | None = None
         self._inflight: set[asyncio.Task] = set()
+        # Per-client nonce so filler transactions differ across clients and
+        # no two authorities seal byte-identical batches (the reference uses
+        # random filler bytes, benchmark_client.rs).
+        import secrets
+
+        self._nonce = secrets.token_bytes(8)
 
     async def wait_for_nodes(self, timeout: float = 30.0) -> None:
         """Wait until every node's tx port accepts connections
@@ -70,6 +76,9 @@ class BenchmarkClient:
             logger.warning("Failed to send transaction burst: %s", e)
 
     async def run(self) -> None:
+        # Parameter lines the log parser reads (benchmark_client.rs logs).
+        logger.info("Transactions size: %d B", self.size)
+        logger.info("Transactions rate: %d tx/s", self.rate)
         logger.info("Start sending transactions")
         # At low rates fall back to 1-tx bursts at `rate` ticks/s so the
         # delivered rate matches the requested one instead of rounding up.
@@ -83,9 +92,12 @@ class BenchmarkClient:
             sample_id = self.counter
             for i in range(burst):
                 if i == 0:
-                    tx = b"\0" + struct.pack(">Q", sample_id)
+                    # Sample marker + id, then the nonce: low-rate clients
+                    # (burst == 1) send only samples, which must still differ
+                    # across clients or authorities seal identical batches.
+                    tx = b"\0" + struct.pack(">Q", sample_id) + self._nonce
                 else:
-                    tx = b"\1" + struct.pack(">Q", self.counter * burst + i)
+                    tx = b"\1" + struct.pack(">Q", self.counter * burst + i) + self._nonce
                 txs.append(tx.ljust(self.size, b"\0"))
             logger.info("Sending sample transaction %d", sample_id)
             # Fire-and-forget: a slow ack must not stall the rate loop.

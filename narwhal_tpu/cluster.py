@@ -71,6 +71,7 @@ class Cluster:
         internal_consensus: bool = True,
         benchmark: bool = False,
         store_base: str | None = None,
+        crypto_backend: str = "cpu",
     ):
         self.fixture = CommitteeFixture(size=size, workers=workers)
         self.parameters = parameters or replace(
@@ -79,6 +80,7 @@ class Cluster:
         self.internal_consensus = internal_consensus
         self.benchmark = benchmark
         self.store_base = store_base
+        self.crypto_backend = crypto_backend
         # Pre-assign real ports so no early broadcast targets a placeholder.
         committee = self.fixture.committee
         for pk, auth in committee.authorities.items():
@@ -116,6 +118,7 @@ class Cluster:
             self.parameters,
             storage,
             internal_consensus=self.internal_consensus,
+            crypto_backend=self.crypto_backend,
         )
         await details.primary.spawn()
         for wid in range(self.fixture.workers_per_authority):

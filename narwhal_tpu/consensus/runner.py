@@ -101,8 +101,16 @@ class Consensus:
             cert = output.certificate
             if cert.round % 10 == 0:
                 logger.debug("Committed %s round %s", cert.digest.hex()[:16], cert.round)
-            # The benchmark-parsed commit line (consensus.rs:312-316).
+            # The benchmark-parsed commit lines (consensus.rs:305-316): one
+            # per payload batch, mirroring the Created lines.
             logger.info("Committed B%s(%s)", cert.round, cert.digest.hex())
+            for batch_digest in cert.header.payload:
+                logger.info(
+                    "Committed B%s(%s) -> %s",
+                    cert.round,
+                    cert.digest.hex(),
+                    batch_digest.hex(),
+                )
             if self.metrics is not None:
                 self.metrics.last_committed_round.set(self.state.last_committed_round)
                 self.metrics.committed_certificates.inc()

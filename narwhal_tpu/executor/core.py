@@ -72,8 +72,8 @@ class ExecutorCore:
         self.execution_indices = await self.execution_state.load_execution_indices()
         try:
             while True:
-                output: ConsensusOutput = await self.rx_subscriber.recv()
-                await self.execute_certificate(output)
+                output, batches = await self.rx_subscriber.recv()
+                await self.execute_certificate(output, batches)
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -82,20 +82,25 @@ class ExecutorCore:
             logger.critical("execution halted on node error", exc_info=True)
             raise
 
-    async def execute_certificate(self, output: ConsensusOutput) -> None:
-        """(core.rs:129-259)."""
+    async def execute_certificate(
+        self, output: ConsensusOutput, batches: dict[bytes, Batch] | None = None
+    ) -> None:
+        """(core.rs:129-259). `batches` is the subscriber's in-memory staging;
+        the temp store is only a fallback (e.g. crash replay paths)."""
         certificate = output.certificate
         payload = list(certificate.header.payload.items())
         total_batches = len(payload)
         for batch_index, (digest, _worker_id) in enumerate(payload):
             if batch_index < self.execution_indices.next_batch_index:
                 continue  # crash replay: batch already fully executed
-            raw = self.temp_batch_store.read(digest)
-            if raw is None:
-                raise ExecutionStateError(
-                    f"staged batch {digest.hex()[:16]} missing from temp store"
-                )
-            batch = Batch.from_bytes(raw)
+            batch = (batches or {}).get(digest)
+            if batch is None:
+                raw = self.temp_batch_store.read(digest)
+                if raw is None:
+                    raise ExecutionStateError(
+                        f"staged batch {digest.hex()[:16]} missing from temp store"
+                    )
+                batch = Batch.from_bytes(raw)
             await self._execute_batch(output, batch, total_batches)
         if total_batches == 0:
             # Empty certificate: still advances the certificate cursor.

@@ -47,13 +47,17 @@ class Subscriber:
         self._task = asyncio.ensure_future(self.run())
         return self._task
 
-    async def _fetch_batch(self, digest: bytes, worker_id: int) -> None:
+    async def _fetch_batch(self, digest: bytes, worker_id: int) -> Batch:
         """Fetch one batch from our own worker with infinite exponential
-        backoff (subscriber.rs:65-72), staging it in the temp store."""
-        if self.temp_batch_store.contains(digest):
-            return
+        backoff (subscriber.rs:65-72). The temp store is a cache; the batch
+        itself is returned so the core never depends on store lifetime (two
+        certificates may legitimately reference byte-identical batches, and
+        the first one's cleanup must not starve the second)."""
         delay = 0.05
         while True:
+            raw = self.temp_batch_store.read(digest)
+            if raw is not None:
+                return Batch.from_bytes(raw)
             try:
                 info = self.worker_cache.worker(self.name, worker_id)
                 resp: RequestedBatchMsg = await self.network.request(
@@ -62,20 +66,24 @@ class Subscriber:
                 batch = Batch(resp.transactions)
                 if batch.digest == digest:
                     self.temp_batch_store.write(digest, batch.to_bytes())
-                    return
+                    return batch
                 # Worker doesn't have it yet (empty reply) or corrupt: retry.
             except (RpcError, OSError, KeyError) as e:
                 logger.debug("batch fetch retry for %s: %s", digest.hex()[:16], e)
             await asyncio.sleep(delay)
             delay = min(delay * 2, 5.0)
 
-    async def _stage(self, output: ConsensusOutput) -> ConsensusOutput:
+    async def _stage(
+        self, output: ConsensusOutput
+    ) -> tuple[ConsensusOutput, dict[bytes, Batch]]:
         payload = output.certificate.header.payload
+        batches: dict[bytes, Batch] = {}
         if payload:
-            await asyncio.gather(
+            fetched = await asyncio.gather(
                 *(self._fetch_batch(d, w) for d, w in payload.items())
             )
-        return output
+            batches = dict(zip(payload.keys(), fetched))
+        return output, batches
 
     async def run(self) -> None:
         pending = BoundedFuturesOrdered(MAX_PENDING_PAYLOADS)

@@ -1,0 +1,161 @@
+"""ctypes loader for the native (C++) runtime components.
+
+Builds native/storage_engine.cpp into a shared library on first use (cached
+by source mtime) and exposes a thin wrapper. Loading is best-effort: when the
+toolchain or library is unavailable the callers fall back to the pure-Python
+implementations, so the framework never hard-depends on a compiler at
+runtime. Disable explicitly with NARWHAL_NATIVE=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+logger = logging.getLogger("narwhal.native")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "storage_engine.cpp")
+_LIB = os.path.join(_ROOT, "native", "libnarwhal_storage.so")
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return True
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC, "-lz"],
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except (OSError, subprocess.CalledProcessError) as e:
+        logger.warning("native storage engine build failed: %s", e)
+        return False
+
+
+def load() -> ctypes.CDLL | None:
+    """The shared library, built on demand; None if unavailable."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("NARWHAL_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SRC) or not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError as e:
+        logger.warning("native storage engine load failed: %s", e)
+        return None
+    lib.nse_open.restype = ctypes.c_void_p
+    lib.nse_open.argtypes = [ctypes.c_char_p]
+    lib.nse_write_batch.restype = ctypes.c_int
+    lib.nse_write_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.nse_get.restype = ctypes.c_int
+    lib.nse_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.nse_contains.restype = ctypes.c_int
+    lib.nse_contains.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+    ]
+    lib.nse_len.restype = ctypes.c_uint64
+    lib.nse_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nse_dump.restype = None
+    lib.nse_dump.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.nse_compact.restype = None
+    lib.nse_compact.argtypes = [ctypes.c_void_p]
+    lib.nse_close_log.restype = None
+    lib.nse_close_log.argtypes = [ctypes.c_void_p]
+    lib.nse_close.restype = None
+    lib.nse_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class NativeEngine:
+    """Handle on one C++ engine instance (tables + WAL)."""
+
+    def __init__(self, path: str | None):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native storage engine unavailable")
+        self._lib = lib
+        self._h = lib.nse_open((path or "").encode())
+        if not self._h:
+            raise RuntimeError(f"nse_open failed for {path!r}")
+
+    def write_batch(self, body: bytes) -> None:
+        if self._lib.nse_write_batch(self._h, body, len(body)) != 0:
+            raise RuntimeError("malformed write batch")
+
+    def get(self, cf: bytes, key: bytes) -> bytes | None:
+        val = ctypes.POINTER(ctypes.c_ubyte)()
+        vlen = ctypes.c_uint32()
+        hit = self._lib.nse_get(
+            self._h, cf, key, len(key), ctypes.byref(val), ctypes.byref(vlen)
+        )
+        if not hit:
+            return None
+        return ctypes.string_at(val, vlen.value)
+
+    def contains(self, cf: bytes, key: bytes) -> bool:
+        return bool(self._lib.nse_contains(self._h, cf, key, len(key)))
+
+    def len(self, cf: bytes) -> int:
+        return int(self._lib.nse_len(self._h, cf))
+
+    def items(self, cf: bytes) -> list[tuple[bytes, bytes]]:
+        buf = ctypes.POINTER(ctypes.c_ubyte)()
+        blen = ctypes.c_uint64()
+        self._lib.nse_dump(self._h, cf, ctypes.byref(buf), ctypes.byref(blen))
+        raw = ctypes.string_at(buf, blen.value) if blen.value else b""
+        out = []
+        pos = 0
+        while pos < len(raw):
+            klen = int.from_bytes(raw[pos : pos + 4], "little")
+            pos += 4
+            key = raw[pos : pos + klen]
+            pos += klen
+            vlen = int.from_bytes(raw[pos : pos + 4], "little")
+            pos += 4
+            out.append((key, raw[pos : pos + vlen]))
+            pos += vlen
+        return out
+
+    def compact(self) -> None:
+        self._lib.nse_compact(self._h)
+
+    def close(self) -> None:
+        """Stop appends; tables stay readable (Python-engine close parity —
+        late reads during shutdown must not hit a freed handle)."""
+        if self._h:
+            self._lib.nse_close_log(self._h)
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            try:
+                self._lib.nse_close(h)
+            except Exception:
+                pass

@@ -78,6 +78,7 @@ class PrimaryNode:
         internal_consensus: bool = True,
         consensus_protocol: str = "bullshark",
         registry: Registry | None = None,
+        crypto_backend: str = "cpu",  # cpu | pool | tpu
     ):
         self.keypair = keypair
         self.name: PublicKey = keypair.public
@@ -94,6 +95,18 @@ class PrimaryNode:
         self.tx_consensus_output = Channel(10_000)
         self.tx_execution_output = Channel(10_000)
 
+        # Crypto backend (the --crypto-backend flag of SURVEY §7.8c):
+        #   cpu  — inline host verification in the Core (reference behavior)
+        #   pool — async coalescing stage over the host library
+        #   tpu  — async coalescing stage over the TPU batch kernel
+        crypto_pool = None
+        if crypto_backend in ("pool", "tpu"):
+            from .tpu.verifier import AsyncVerifierPool, make_batch_verifier
+
+            backend = make_batch_verifier() if crypto_backend == "tpu" else None
+            crypto_pool = AsyncVerifierPool(backend=backend)
+        self.crypto_pool = crypto_pool
+
         self.primary = Primary(
             self.name,
             SignatureService(keypair),
@@ -109,6 +122,7 @@ class PrimaryNode:
                 else NetworkModel.ASYNCHRONOUS
             ),
             registry=self.registry,
+            crypto_pool=crypto_pool,
         )
 
         self.consensus: Consensus | None = None

@@ -31,6 +31,7 @@ from ..types import (
 )
 from .aggregators import CertificatesAggregator, VotesAggregator
 from .synchronizer import Synchronizer
+from .verifier_stage import PreVerified
 
 logger = logging.getLogger("narwhal.primary")
 
@@ -247,28 +248,35 @@ class Core:
     # ------------------------------------------------------------------
     # Sanitization (core.rs:497-573)
     # ------------------------------------------------------------------
-    def sanitize_header(self, header: Header) -> None:
+    def sanitize_header(self, header: Header, preverified: bool = False) -> None:
         if header.epoch != self.committee.epoch:
             raise InvalidEpoch(f"header from epoch {header.epoch}")
         if header.round <= self.gc_round:
             raise TooOld(f"header round {header.round} <= gc {self.gc_round}")
-        header.verify(self.committee, self.worker_cache)
+        header.verify(self.committee, self.worker_cache, check_signature=not preverified)
 
-    def sanitize_vote(self, vote: Vote) -> None:
+    def sanitize_vote(self, vote: Vote, preverified: bool = False) -> None:
         if vote.epoch != self.committee.epoch:
             raise InvalidEpoch(f"vote from epoch {vote.epoch}")
         if self.current_header is None or vote.round < self.current_header.round:
             raise TooOld(f"vote for stale round {vote.round}")
-        vote.verify(self.committee)
+        vote.verify(self.committee, check_signature=not preverified)
 
-    def sanitize_certificate(self, certificate: Certificate) -> None:
+    def sanitize_certificate(
+        self, certificate: Certificate, preverified: bool = False
+    ) -> None:
         if certificate.epoch != self.committee.epoch:
             raise InvalidEpoch(f"certificate from epoch {certificate.epoch}")
         if certificate.round < self.gc_round:
             raise TooOld(
                 f"certificate round {certificate.round} < gc {self.gc_round}"
             )
-        certificate.verify(self.committee, self.worker_cache)
+        if preverified:
+            # Signatures checked by the verifier stage; re-run only the
+            # structural/stake checks.
+            certificate.verify_items(self.committee)
+        else:
+            certificate.verify(self.committee, self.worker_cache)
 
     def _observe_round(self, round: Round) -> None:
         """Track the highest round seen for metrics (core.rs:434-443)."""
@@ -279,16 +287,19 @@ class Core:
     # Main loop (core.rs:615-715)
     # ------------------------------------------------------------------
     async def _handle_message(self, msg) -> None:
+        preverified = isinstance(msg, PreVerified)
+        if preverified:
+            msg = msg.inner
         try:
             if isinstance(msg, Header):
-                self.sanitize_header(msg)
+                self.sanitize_header(msg, preverified)
                 self._observe_round(msg.round)
                 await self.process_header(msg)
             elif isinstance(msg, Vote):
-                self.sanitize_vote(msg)
+                self.sanitize_vote(msg, preverified)
                 await self.process_vote(msg)
             elif isinstance(msg, Certificate):
-                self.sanitize_certificate(msg)
+                self.sanitize_certificate(msg, preverified)
                 self._observe_round(msg.round)
                 await self.process_certificate(msg)
             else:
@@ -382,6 +393,9 @@ class Core:
         self.votes_aggregator = VotesAggregator()
         self.certificates_aggregators.clear()
         self.processing.clear()
+        # Rounds restart at 0: the persistent per-author vote guard must be
+        # wiped or no new-epoch header ever gets a vote (core.rs:598-601).
+        self.vote_digest_store.clear()
         for handlers in self.cancel_handlers.values():
             for handler in handlers:
                 handler.cancel()

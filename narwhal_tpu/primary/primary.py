@@ -58,6 +58,7 @@ class Primary:
         rx_committed_certificates: Channel,  # <- consensus
         network_model: NetworkModel = NetworkModel.PARTIALLY_SYNCHRONOUS,
         registry: Registry | None = None,
+        crypto_pool=None,  # AsyncVerifierPool: enables the pre-verify stage
     ):
         self.name = name
         self.committee = committee
@@ -165,6 +166,18 @@ class Primary:
         self.payload_receiver = PayloadReceiver(
             storage.payload_store, self.tx_others_digests
         )
+        if crypto_pool is not None:
+            from .verifier_stage import VerifierStage
+
+            self.verifier_stage = VerifierStage(
+                committee,
+                worker_cache,
+                crypto_pool,
+                self.tx_primary_messages,
+                rx_reconfigure=self.tx_reconfigure,
+            )
+        else:
+            self.verifier_stage = None
         self.state_handler = StateHandler(
             name,
             committee,
@@ -211,16 +224,25 @@ class Primary:
         )
 
     # -- handlers ----------------------------------------------------------
+    async def _ingest(self, msg) -> None:
+        """Protocol messages go through the async verification stage when a
+        crypto pool is configured (signatures batched off the Core's loop),
+        else straight to the Core."""
+        if self.verifier_stage is not None:
+            await self.verifier_stage.submit(msg)
+        else:
+            await self.tx_primary_messages.send(msg)
+
     async def _on_header(self, msg: HeaderMsg, peer: str):
-        await self.tx_primary_messages.send(msg.header)
+        await self._ingest(msg.header)
         return None
 
     async def _on_vote(self, msg: VoteMsg, peer: str):
-        await self.tx_primary_messages.send(msg.vote)
+        await self._ingest(msg.vote)
         return None
 
     async def _on_certificate(self, msg: CertificateMsg, peer: str):
-        await self.tx_primary_messages.send(msg.certificate)
+        await self._ingest(msg.certificate)
         return None
 
     async def _on_our_batch(self, msg: OurBatchMsg, peer: str):
@@ -240,6 +262,8 @@ class Primary:
     # -- lifecycle ---------------------------------------------------------
     async def shutdown(self) -> None:
         self.tx_reconfigure.send(ReconfigureNotification("shutdown"))
+        if self.verifier_stage is not None:
+            self.verifier_stage.shutdown()
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

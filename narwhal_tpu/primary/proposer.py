@@ -120,8 +120,16 @@ class Proposer:
         self.digests.clear()
         self.payload_size = 0
         self.last_parents = []
-        # Benchmark-parsed creation line (proposer.rs:117-121).
+        # Benchmark-parsed creation lines (proposer.rs:110-121): one line per
+        # payload batch so the harness can tie batches to proposals.
         logger.info("Created B%s(%s)", header.round, header.digest.hex())
+        for batch_digest in header.payload:
+            logger.info(
+                "Created B%s(%s) -> %s",
+                header.round,
+                header.digest.hex(),
+                batch_digest.hex(),
+            )
         if self.metrics is not None:
             self.metrics.proposed_headers.inc()
         await self.tx_core.send(header)

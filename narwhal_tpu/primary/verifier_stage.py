@@ -1,0 +1,110 @@
+"""Async pre-verification stage: pipeline signature checks off the Core.
+
+The reference verifies every header/vote/certificate inline in the Core's
+single-threaded loop (core.rs sanitize_*, the crypto hot path named by the
+north star). Here, when a crypto pool is configured, the RPC handlers hand
+messages to this stage instead: structural checks run immediately, signature
+items go to the AsyncVerifierPool (which coalesces across ALL concurrently
+arriving messages into fixed-shape device batches), and only successfully
+verified messages are forwarded to the Core wrapped in `PreVerified` so its
+sanitize step skips redundant signature work. The Core state machine stays
+single-threaded; only crypto becomes pipelined + batched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..channels import Channel
+from ..config import Committee, WorkerCache
+from ..types import Certificate, DagError, Header, Vote
+
+logger = logging.getLogger("narwhal.primary")
+
+
+class PreVerified:
+    """Marker carrying a message whose signatures have been checked."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, inner):
+        self.inner = inner
+
+
+class VerifierStage:
+    def __init__(
+        self,
+        committee: Committee,
+        worker_cache: WorkerCache,
+        pool,  # AsyncVerifierPool-compatible: await pool.verify(pk, msg, sig)
+        tx_out: Channel,
+        rx_reconfigure=None,  # Watch[ReconfigureNotification]: epoch swaps
+        max_pending: int = 1_024,
+    ):
+        self._committee = committee
+        self.worker_cache = worker_cache
+        self.pool = pool
+        self.tx_out = tx_out
+        self.rx_reconfigure = rx_reconfigure
+        self._sem = asyncio.Semaphore(max_pending)
+        self._tasks: set[asyncio.Task] = set()
+
+    @property
+    def committee(self) -> Committee:
+        """Latest committee: epoch changes land on the reconfigure watch, and
+        a stage pinned to the boot committee would silently drop every
+        new-epoch message."""
+        if self.rx_reconfigure is not None:
+            note = self.rx_reconfigure.value
+            if note is not None and getattr(note, "committee", None) is not None:
+                self._committee = note.committee
+        return self._committee
+
+    async def submit(self, msg) -> None:
+        await self._sem.acquire()
+        task = asyncio.ensure_future(self._verify(msg))
+        self._tasks.add(task)
+
+        def _done(t: asyncio.Task) -> None:
+            self._tasks.discard(t)
+            self._sem.release()
+
+        task.add_done_callback(_done)
+
+    async def _verify(self, msg) -> None:
+        try:
+            if isinstance(msg, Header):
+                msg.verify(self.committee, self.worker_cache, check_signature=False)
+                items = [msg.signature_item()]
+            elif isinstance(msg, Vote):
+                msg.verify(self.committee, check_signature=False)
+                items = [msg.signature_item()]
+            elif isinstance(msg, Certificate):
+                items = msg.verify_items(self.committee)
+                if items:
+                    msg.header.verify(
+                        self.committee, self.worker_cache, check_signature=False
+                    )
+                    items.append(msg.header.signature_item())
+            else:
+                await self.tx_out.send(msg)
+                return
+        except DagError as e:
+            logger.debug("verifier stage dropped malformed message: %s", e)
+            return
+        if items:
+            results = await asyncio.gather(
+                *(self.pool.verify(pk, m, sig) for pk, m, sig in items)
+            )
+            if not all(results):
+                logger.warning(
+                    "verifier stage rejected %s with bad signature",
+                    type(msg).__name__,
+                )
+                return
+        await self.tx_out.send(PreVerified(msg))
+
+    def shutdown(self) -> None:
+        for t in list(self._tasks):
+            t.cancel()

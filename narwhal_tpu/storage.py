@@ -14,6 +14,13 @@ replays the WAL; a torn tail record is discarded, giving atomic write_batch.
 This trades RocksDB's compaction machinery for zero-dependency simplicity;
 `compact()` rewrites the log when garbage exceeds a threshold (GC deletes
 from consensus would otherwise grow it unboundedly).
+
+Two interchangeable backends share the byte-identical on-disk format: the
+pure-Python engine below, and the native C++ engine (native/
+storage_engine.cpp via narwhal_tpu/native.py, the analog of the reference's
+RocksDB C++ core). The native one is used when it builds/loads; set
+NARWHAL_NATIVE=0 to force Python. The notify_read waiter plane always lives
+in Python (it is event-loop state, not storage).
 """
 
 from __future__ import annotations
@@ -31,13 +38,27 @@ class StorageEngine:
     """One per node, holding every column family (the RocksDB instance
     analog). path=None runs purely in memory (tests)."""
 
-    def __init__(self, path: str | None):
+    def __init__(self, path: str | None, use_native: bool | None = None):
         self._path = path
         self._cfs: dict[str, "ColumnFamily"] = {}
         self._log = None
         self._cf_ids: dict[str, int] = {}
         self._dirty_bytes = 0
         self._append_count = 0
+        self._native = None
+        if use_native is None:
+            use_native = os.environ.get("NARWHAL_NATIVE", "1") != "0"
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+        if use_native:
+            try:
+                from .native import NativeEngine
+
+                self._native = NativeEngine(path)
+            except (RuntimeError, OSError):
+                self._native = None
+        if self._native is not None:
+            return
         if path is not None:
             os.makedirs(path, exist_ok=True)
             self._log_path = os.path.join(path, "wal.log")
@@ -102,17 +123,7 @@ class StorageEngine:
     def _append(self, ops: list[tuple[int, str, bytes, bytes]]) -> None:
         if self._log is None:
             return
-        parts = [struct.pack("<I", len(ops))]
-        for op, name, key, value in ops:
-            nb = name.encode()
-            parts.append(struct.pack("<BH", op, len(nb)))
-            parts.append(nb)
-            parts.append(struct.pack("<I", len(key)))
-            parts.append(key)
-            if op == 0:
-                parts.append(struct.pack("<I", len(value)))
-                parts.append(value)
-        body = b"".join(parts)
+        body = self._encode_ops(ops)
         self._log.write(_HDR.pack(len(body), zlib.crc32(body)) + body)
         self._log.flush()
         self._dirty_bytes += len(body)
@@ -153,17 +164,33 @@ class StorageEngine:
         self._log = open(self._log_path, "ab")
         self._dirty_bytes = self._live_size_estimate()
 
+    @staticmethod
+    def _encode_ops(ops: list[tuple[int, str, bytes, bytes]]) -> bytes:
+        parts = [struct.pack("<I", len(ops))]
+        for op, name, key, value in ops:
+            nb = name.encode()
+            parts.append(struct.pack("<BH", op, len(nb)))
+            parts.append(nb)
+            parts.append(struct.pack("<I", len(key)))
+            parts.append(key)
+            if op == 0:
+                parts.append(struct.pack("<I", len(value)))
+                parts.append(value)
+        return b"".join(parts)
+
     def write_batch(self, puts: list[tuple["ColumnFamily", bytes, bytes]], deletes: list[tuple["ColumnFamily", bytes]] = ()) -> None:
         """Atomic multi-CF write (reference: rocksdb WriteBatch used by
         CertificateStore.write, storage/src/certificate_store.rs:55-120)."""
-        ops = []
-        for cf, key, value in puts:
-            cf._data[key] = value
-            ops.append((0, cf.name, key, value))
-        for cf, key in deletes:
-            cf._data.pop(key, None)
-            ops.append((1, cf.name, key, b""))
-        self._append(ops)
+        ops = [(0, cf.name, key, value) for cf, key, value in puts]
+        ops += [(1, cf.name, key, b"") for cf, key in deletes]
+        if self._native is not None:
+            self._native.write_batch(self._encode_ops(ops))
+        else:
+            for cf, key, value in puts:
+                cf._data[key] = value
+            for cf, key in deletes:
+                cf._data.pop(key, None)
+            self._append(ops)
         for cf, key, value in puts:
             cf._notify(key, value)
 
@@ -171,6 +198,9 @@ class StorageEngine:
         if self._log is not None:
             self._log.close()
             self._log = None
+        if self._native is not None:
+            self._native.close()
+            self._native = None
 
 
 class ColumnFamily:
@@ -180,6 +210,8 @@ class ColumnFamily:
     def __init__(self, name: str, engine: StorageEngine):
         self.name = name
         self._engine = engine
+        self._native = engine._native  # shared handle; None => dict backend
+        self._nname = name.encode()
         self._data: dict[bytes, bytes] = {}
         self._waiters: dict[bytes, list[asyncio.Future]] = {}
 
@@ -191,12 +223,16 @@ class ColumnFamily:
         self._engine.write_batch([(self, k, v) for k, v in items])
 
     def get(self, key: bytes) -> bytes | None:
+        if self._native is not None:
+            return self._native.get(self._nname, key)
         return self._data.get(key)
 
     def get_all(self, keys: Iterable[bytes]) -> list[bytes | None]:
-        return [self._data.get(k) for k in keys]
+        return [self.get(k) for k in keys]
 
     def contains(self, key: bytes) -> bool:
+        if self._native is not None:
+            return self._native.contains(self._nname, key)
         return key in self._data
 
     def delete(self, key: bytes) -> None:
@@ -206,12 +242,18 @@ class ColumnFamily:
         self._engine.write_batch([], [(self, k) for k in keys])
 
     def iter(self) -> Iterator[tuple[bytes, bytes]]:
+        if self._native is not None:
+            return iter(self._native.items(self._nname))
         return iter(list(self._data.items()))
 
     def keys(self) -> list[bytes]:
+        if self._native is not None:
+            return [k for k, _ in self._native.items(self._nname)]
         return list(self._data)
 
     def __len__(self) -> int:
+        if self._native is not None:
+            return self._native.len(self._nname)
         return len(self._data)
 
     # -- notify_read ------------------------------------------------------
@@ -219,7 +261,7 @@ class ColumnFamily:
         """Return the value, blocking until someone writes it
         (storage/src/certificate_store.rs:138-160). Cancellation-safe: a
         cancelled waiter is pruned on the next notify."""
-        val = self._data.get(key)
+        val = self.get(key)
         if val is not None:
             return val
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

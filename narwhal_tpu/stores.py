@@ -198,6 +198,11 @@ class VoteDigestStore:
         (r,) = struct.unpack("<Q", raw[:8])
         return r, raw[8:]
 
+    def clear(self) -> None:
+        """Epoch change: rounds restart at 0, so per-epoch vote guards must
+        reset with them (core.rs change_epoch clears this store)."""
+        self._cf.delete_all(self._cf.keys())
+
 
 class ConsensusStore:
     """last_committed per authority + global sequence

@@ -5,27 +5,30 @@ behind fastcrypto's `VerifyingKey`, /root/reference/crypto/src/lib.rs:29-46;
 hot at `Certificate::verify`, /root/reference/types/src/primary.rs:487-537)
 with one device dispatch per batch of signatures.
 
-TPU-first design notes (see /opt/skills/guides/pallas_guide.md and SURVEY §7.8a):
+TPU-first design notes (see /opt/skills/guides/pallas_guide.md, SURVEY §7.8a):
 
-- **Field arithmetic mod p = 2^255-19 in radix 2^13**: 20 int32 limbs.
-  Products of two 13-bit limbs are 26-bit; a 39-term school-book column sum
-  stays under 2^31, so the whole multiplier runs in native int32 lanes on the
-  VPU — no 64-bit emulation, no dynamic shapes. Static-shift partial products
-  (an unrolled 20-tap convolution) vectorize across the batch axis.
-- **Reduction** folds limb k+20 back with weight 608 (2^260 ≡ 19·2^5), then
-  the bit-255 overflow with weight 19; limbs stay "almost reduced" (< 2p)
-  except where equality tests require canonical form.
-- **One traced scalar path, vmapped**: verification is written for a single
-  signature and `jax.vmap`-ed, so XLA sees a fixed-shape [B, ...] program with
-  a `lax.scan` over the 64 windowed-scalar steps.
-- **Shared-doubling Straus**: Rcheck = [S]B + [k](-A) computed with one run
-  of 252 doublings and 2x64 table additions (4-bit windows); the B table is a
-  host-precomputed constant (ed25519_ref.base_window_table), the -A table is
-  built on device (15 additions). The extended-Edwards addition law is
-  complete on this curve, so identity entries need no branches — exactly the
-  compiler-friendly control flow the MXU/VPU pipeline wants.
-- Verification equation matches the host library (cofactorless):
-  encode([S]B - [k]A) == R bytes, with canonicality prechecks on host.
+- **Limb-major layout**: a field element batch is int32[NLIMB, B] — the
+  batch axis fills the VPU's 128-wide lanes; limbs live on the sublane axis
+  so carry shifts are row moves, not lane shuffles. (The transposed [B, 20]
+  layout leaves 6/7 of every vector register empty.)
+- **Field arithmetic mod p = 2^255-19 in radix 2^13**: 20 limbs. Products of
+  13-bit limbs are 26-bit; a 20-term column sum stays under 2^31, so the
+  whole multiplier runs in native int32 lanes — no 64-bit emulation.
+- **Parallel carries**: overflow moves one limb up per vector round; fixed
+  round counts with statically-proven bounds (below) restore the invariant.
+- **Shared-doubling Straus**: Rcheck = [S]B + [k](-A) in one run of 252
+  doublings + 2x64 windowed table additions under `lax.scan`; the B table is
+  a host constant, the -A table is built on device. The extended-Edwards
+  addition law is complete here, so identity entries need no branches.
+- Verification matches the host library (cofactorless):
+  encode([S]B - [k]A) == R, with canonicality prechecks on host.
+
+Bound bookkeeping (all < 2^31):
+  loose invariant: limbs in [0, LOOSE = 9500]
+  mul columns: 20 * 9500^2 = 1.805e9; fold adds <= 1.94e9; 4 rounds -> ~8800
+  add: <= 19000, 2 rounds -> <= 9409
+  sub: a + 64p - b with 64p = [15168, 16382 x19] (every limb >= 15168 keeps
+       differences positive), 3 rounds -> <= ~8801
 
 The host wrapper lives in narwhal_tpu/tpu/verifier.py.
 """
@@ -46,6 +49,7 @@ NLIMB = 20
 RADIX = 13
 MASK = (1 << RADIX) - 1
 WINDOWS = 64  # 4-bit windows over 256-bit scalars, MSB first
+LOOSE = 9500
 
 
 def int_to_limbs(x: int) -> np.ndarray:
@@ -53,163 +57,167 @@ def int_to_limbs(x: int) -> np.ndarray:
 
 
 def limbs_to_int(limbs) -> int:
-    return sum(int(v) << (RADIX * i) for i, v in enumerate(np.asarray(limbs)))
+    arr = np.asarray(limbs)
+    if arr.ndim > 1:
+        arr = arr[..., 0] if arr.shape[-1] == 1 else arr.squeeze()
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(arr))
+
+
+def _col(x: int) -> np.ndarray:
+    """Constant as a broadcastable [NLIMB, 1] column."""
+    return int_to_limbs(x)[:, None]
 
 
 _P_LIMBS = int_to_limbs(ref.P)
-_2P_LIMBS = (2 * _P_LIMBS).astype(np.int32)
-_D = int_to_limbs(ref.D)
-_2D = int_to_limbs(2 * ref.D % ref.P)
-_SQRT_M1 = int_to_limbs(ref.SQRT_M1)
-_ONE = int_to_limbs(1)
-_ZERO = int_to_limbs(0)
+_D = _col(ref.D)
+_2D = _col(2 * ref.D % ref.P)
+_SQRT_M1 = _col(ref.SQRT_M1)
+_ONE = _col(1)
 
-# Fixed-base window table: 16 small multiples of B in affine (x, y, x*y),
-# identity at index 0 as (0, 1, 0) with its Z supplied as 1 on device.
+# 64p = 2^261 - 1216 with every limb large: per-limb subtraction bias.
+_SUB_BIAS = np.array([15168] + [16382] * (NLIMB - 1), np.int32)[:, None]
+assert limbs_to_int(_SUB_BIAS) == 64 * ref.P
+
+# Fixed-base window table: 16 small multiples of B in affine (x, y, x*y);
+# identity row is (0, 1, 0) and Z is forced to 1 at selection time.
 _BT = np.zeros((16, 3, NLIMB), np.int32)
-for _d, (_x, _y, _t) in enumerate(ref.base_window_table()):
-    _BT[_d, 0] = int_to_limbs(_x)
-    _BT[_d, 1] = int_to_limbs(_y)
-    _BT[_d, 2] = int_to_limbs(_t)
+for _dd, (_x, _y, _t) in enumerate(ref.base_window_table()):
+    _BT[_dd, 0] = int_to_limbs(_x)
+    _BT[_dd, 1] = int_to_limbs(_y)
+    _BT[_dd, 2] = int_to_limbs(_t)
 
 
 # ---------------------------------------------------------------------------
-# Field element ops. A field element is an int32[NLIMB] array in LOOSE form:
-# limbs in [0, LOOSE] with LOOSE = 9500 (value may exceed 2^255; only
-# congruence mod p is maintained). Carries are propagated by PARALLEL rounds
-# (vector shift/mask/add, no 20-step sequential chain): one round moves every
-# limb's overflow one position up at once, and the bounds below prove a fixed
-# small number of rounds restores the loose invariant. This keeps the XLA
-# graph small and the dependency chains short — the whole multiplier is ~50
-# vector ops on int32 lanes.
-#
-# Bound bookkeeping (documented invariants, all < 2^31):
-#   mul columns: 20 * LOOSE^2 = 1.805e9          (inputs loose)
-#   mul fold:    col + 608*8191 + 608*(col>>13) <= 1.94e9
-#   mul: 4 carry rounds -> limbs <= ~8800
-#   add: inputs loose -> sum <= 19000, 2 rounds -> <= 9409
-#   sub: a + 64p - b with 64p = [15168, 16382 x19] (all limbs >= 15168, so
-#        every limb difference stays positive), 3 rounds -> <= ~8801
+# Field ops: arrays are [NLIMB] or [NLIMB, B]; the limb axis is ALWAYS 0.
 # ---------------------------------------------------------------------------
-
-LOOSE = 9500
 
 
 def _carry_round(r):
-    """One parallel carry round over NLIMB limbs; limb-19 overflow (weight
-    2^260 == 608 mod p) folds into limb 0."""
+    """One parallel carry round; limb-19 overflow (2^260 == 608 mod p) wraps
+    to limb 0 — a single rotated add, no scatter."""
     hi = r >> RADIX
     lo = r & MASK
-    up = jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
-    return lo + up + 608 * jnp.where(
-        jnp.arange(NLIMB) == 0, hi[..., NLIMB - 1 : NLIMB], 0
-    )
+    return lo + jnp.concatenate([608 * hi[-1:], hi[:-1]], axis=0)
 
 
 def fe_add(a, b):
-    r = a + b
-    r = _carry_round(r)
-    return _carry_round(r)
-
-
-# 64p = 2^261 - 1216 expressed with every limb large (>= 15168): per-limb
-# subtraction below never goes negative for loose inputs.
-_SUB_BIAS = np.array([15168] + [16382] * (NLIMB - 1), np.int32)
-assert limbs_to_int(_SUB_BIAS) == 64 * ref.P
+    return _carry_round(_carry_round(a + b))
 
 
 def fe_sub(a, b):
-    r = a + jnp.asarray(_SUB_BIAS) - b
-    r = _carry_round(r)
-    r = _carry_round(r)
-    return _carry_round(r)
+    bias = jnp.asarray(_SUB_BIAS if b.ndim > 1 else _SUB_BIAS[:, 0])
+    r = a + bias - b
+    return _carry_round(_carry_round(_carry_round(r)))
 
 
 def fe_neg(a):
-    r = jnp.asarray(_SUB_BIAS) - a
-    r = _carry_round(r)
-    return _carry_round(r)
+    bias = jnp.asarray(_SUB_BIAS if a.ndim > 1 else _SUB_BIAS[:, 0])
+    return _carry_round(_carry_round(bias - a))
 
 
-def fe_mul(a, b):
-    # School-book columns via static shifts: c[k] = sum_{i+j=k} a_i * b_j.
-    c = jnp.zeros(a.shape[:-1] + (2 * NLIMB,), jnp.int32)
-    for i in range(NLIMB):
-        c = c.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
-    # Fold the high half down (2^260 == 608 mod p) without carrying the raw
-    # columns first: split each high column into 13-bit lo + hi so that
-    # 608*hi rides one limb up and nothing overflows int32 (c_39 == 0, so
-    # the shifted d_hi never spills past limb 19).
-    c_lo, c_hi = c[..., :NLIMB], c[..., NLIMB:]
+def _fold_and_carry(cols: list):
+    """39 school-book columns -> loose field element: fold the high half
+    (2^260 == 608 mod p) by 13-bit split so nothing overflows int32, then 4
+    parallel carry rounds (bounds in the module docstring)."""
+    c_lo = jnp.stack(cols[:NLIMB], axis=0)
+    zero = jnp.zeros_like(cols[0])
+    c_hi = jnp.stack(cols[NLIMB:] + [zero], axis=0)
     d_lo = c_hi & MASK
     d_hi = c_hi >> RADIX
-    up = jnp.concatenate([jnp.zeros_like(d_hi[..., :1]), d_hi[..., :-1]], axis=-1)
+    up = jnp.concatenate([jnp.zeros_like(d_hi[:1]), d_hi[:-1]], axis=0)
     r = c_lo + 608 * d_lo + 608 * up
     for _ in range(4):
         r = _carry_round(r)
     return r
 
 
+def fe_mul(a, b):
+    # Row-wise school-book columns: c[k] = sum_{i+j=k} a_i * b_j. Each term
+    # is one [B]-wide multiply-add — no dynamic slicing, pure VPU work.
+    rows_a = [a[i] for i in range(NLIMB)]
+    rows_b = [b[i] for i in range(NLIMB)]
+    cols = []
+    for k in range(2 * NLIMB - 1):
+        lo = max(0, k - NLIMB + 1)
+        hi = min(NLIMB - 1, k)
+        s = rows_a[lo] * rows_b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            s = s + rows_a[i] * rows_b[k - i]
+        cols.append(s)
+    return _fold_and_carry(cols)
+
+
 def fe_sq(a):
-    return fe_mul(a, a)
+    # Squaring: c[k] = 2 * sum_{i<j, i+j=k} a_i a_j (+ a_{k/2}^2) — the
+    # doubled operand keeps products under 19000 * 9500 * 10 < 2^31.
+    rows = [a[i] for i in range(NLIMB)]
+    doubled = [r + r for r in rows]
+    cols = []
+    for k in range(2 * NLIMB - 1):
+        lo = max(0, k - NLIMB + 1)
+        hi = min(NLIMB - 1, k)
+        terms = []
+        i, j = lo, hi
+        while i < j:
+            terms.append(doubled[i] * rows[j])
+            i += 1
+            j -= 1
+        if i == j:
+            terms.append(rows[i] * rows[i])
+        s = terms[0]
+        for t in terms[1:]:
+            s = s + t
+        cols.append(s)
+    return _fold_and_carry(cols)
 
 
 def _carry_chain_exact(r):
-    """Sequential full carry (canonicalization only — not on the hot path)."""
+    """Sequential full carry (canonicalization only — off the hot path)."""
     outs = []
-    carry = jnp.zeros_like(r[..., 0])
+    carry = jnp.zeros_like(r[0])
     for i in range(NLIMB):
-        v = r[..., i] + carry
+        v = r[i] + carry
         outs.append(v & MASK)
         carry = v >> RADIX
-    return jnp.stack(outs, axis=-1), carry
+    return jnp.stack(outs, axis=0), carry
 
 
 def fe_canonical(a):
     """Full reduction to [0, p) from loose form."""
     for _ in range(2):
         a, overflow = _carry_chain_exact(a)
-        # Fold bits >= 255: limb 19 keeps its low 8 bits, the rest (plus the
-        # 2^260-weight overflow) re-enters with weight 19.
-        top = a[..., NLIMB - 1]
+        top = a[NLIMB - 1]
         hi = (top >> 8) + (overflow << (RADIX - 8))
-        a = a.at[..., NLIMB - 1].set(top & 0xFF)
-        a = a.at[..., 0].add(19 * hi)
+        a = a.at[NLIMB - 1].set(top & 0xFF)
+        a = a.at[0].add(19 * hi)
     a, _ = _carry_chain_exact(a)
-    for _ in range(2):  # value now < 2^255 + eps: conditionally subtract p
-        borrow = jnp.zeros_like(a[..., 0])
+    for _ in range(2):  # value < 2^255 + eps: conditionally subtract p
+        borrow = jnp.zeros_like(a[0])
         outs = []
         for i in range(NLIMB):
-            v = a[..., i] - int(_P_LIMBS[i]) - borrow
+            v = a[i] - int(_P_LIMBS[i]) - borrow
             borrow = (v < 0).astype(jnp.int32)
             outs.append(v + (borrow << RADIX))
-        sub = jnp.stack(outs, axis=-1)
-        a = jnp.where((borrow == 0)[..., None], sub, a)
+        sub = jnp.stack(outs, axis=0)
+        a = jnp.where((borrow == 0), sub, a)
     return a
 
 
 def fe_eq(a, b):
-    """Equality of field values (canonicalizes both)."""
-    return jnp.all(fe_canonical(a) == fe_canonical(b), axis=-1)
-
-
-def fe_is_zero(a):
-    return jnp.all(fe_canonical(a) == 0, axis=-1)
+    return jnp.all(fe_canonical(a) == fe_canonical(b), axis=0)
 
 
 def _ladder(z):
     """Shared exponentiation ladder: returns (z^(2^250-1), z^11)."""
-    t0 = fe_sq(z)  # z^2
-    t1 = fe_sq(fe_sq(t0))  # z^8
+    t0 = fe_sq(z)
+    t1 = fe_sq(fe_sq(t0))
     t1 = fe_mul(z, t1)  # z^9
     t0 = fe_mul(t0, t1)  # z^11
-    t2 = fe_sq(t0)  # z^22
-    t1 = fe_mul(t1, t2)  # z^31 = z^(2^5-1)
+    t2 = fe_sq(t0)
+    t1 = fe_mul(t1, t2)  # z^31
     z11 = t0
 
     def times(x, n):
-        # fori_loop keeps the compiled graph small: one fe_sq body per chain
-        # instead of n inlined copies (squarings are sequential regardless).
         if n <= 4:
             for _ in range(n):
                 x = fe_sq(x)
@@ -217,64 +225,60 @@ def _ladder(z):
         return lax.fori_loop(0, n, lambda _, v: fe_sq(v), x)
 
     t2 = times(t1, 5)
-    t1 = fe_mul(t2, t1)  # z^(2^10-1)
+    t1 = fe_mul(t2, t1)  # 2^10-1
     t2 = times(t1, 10)
-    t2 = fe_mul(t2, t1)  # z^(2^20-1)
+    t2 = fe_mul(t2, t1)  # 2^20-1
     t3 = times(t2, 20)
-    t2 = fe_mul(t3, t2)  # z^(2^40-1)
+    t2 = fe_mul(t3, t2)  # 2^40-1
     t2 = times(t2, 10)
-    t1 = fe_mul(t2, t1)  # z^(2^50-1)
+    t1 = fe_mul(t2, t1)  # 2^50-1
     t2 = times(t1, 50)
-    t2 = fe_mul(t2, t1)  # z^(2^100-1)
+    t2 = fe_mul(t2, t1)  # 2^100-1
     t3 = times(t2, 100)
-    t2 = fe_mul(t3, t2)  # z^(2^200-1)
+    t2 = fe_mul(t3, t2)  # 2^200-1
     t2 = times(t2, 50)
-    t1 = fe_mul(t2, t1)  # z^(2^250-1)
+    t1 = fe_mul(t2, t1)  # 2^250-1
     return t1, z11
 
 
 def fe_invert(z):
     t1, z11 = _ladder(z)
     for _ in range(5):
-        t1 = fe_sq(t1)  # z^(2^255-2^5)
-    return fe_mul(t1, z11)  # z^(2^255-21) = z^(p-2)
+        t1 = fe_sq(t1)
+    return fe_mul(t1, z11)  # z^(p-2)
 
 
 def fe_pow22523(z):
     t1, _ = _ladder(z)
-    t1 = fe_sq(fe_sq(t1))  # z^(2^252-4)
+    t1 = fe_sq(fe_sq(t1))
     return fe_mul(t1, z)  # z^(2^252-3)
 
 
 # ---------------------------------------------------------------------------
-# Point ops: extended twisted-Edwards coordinates, stacked as [4, NLIMB]
-# rows (X, Y, Z, T). The addition law is complete on ed25519.
+# Point ops: extended twisted-Edwards coordinates as (X, Y, Z, T) tuples of
+# limb-major arrays. The addition law is complete on ed25519.
 # ---------------------------------------------------------------------------
 
 
-def pt(x, y, z, t):
-    return jnp.stack([x, y, z, t], axis=-2)
-
-
-def pt_identity():
-    return pt(
-        jnp.asarray(_ZERO), jnp.asarray(_ONE), jnp.asarray(_ONE), jnp.asarray(_ZERO)
-    )
+def pt_identity(batch_shape=()):
+    zero = jnp.zeros((NLIMB,) + batch_shape, jnp.int32)
+    one = zero.at[0].set(1)
+    return (zero, one, one, zero)
 
 
 def pt_add(p, q):
-    x1, y1, z1, t1 = p[..., 0, :], p[..., 1, :], p[..., 2, :], p[..., 3, :]
-    x2, y2, z2, t2 = q[..., 0, :], q[..., 1, :], q[..., 2, :], q[..., 3, :]
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
     a = fe_mul(fe_sub(y1, x1), fe_sub(y2, x2))
     b = fe_mul(fe_add(y1, x1), fe_add(y2, x2))
-    c = fe_mul(fe_mul(t1, jnp.asarray(_2D)), t2)
+    c = fe_mul(fe_mul(t1, jnp.asarray(_2D if t1.ndim > 1 else _2D[:, 0])), t2)
     d = fe_mul(fe_add(z1, z1), z2)
     e, f, g, h = fe_sub(b, a), fe_sub(d, c), fe_add(d, c), fe_add(b, a)
-    return pt(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
 def pt_double(p):
-    x1, y1, z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    x1, y1, z1, _ = p
     a = fe_sq(x1)
     b = fe_sq(y1)
     c = fe_add(fe_sq(z1), fe_sq(z1))
@@ -282,20 +286,22 @@ def pt_double(p):
     e = fe_sub(h, fe_sq(fe_add(x1, y1)))
     g = fe_sub(a, b)
     f = fe_add(c, g)
-    return pt(fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
 def pt_neg(p):
-    return pt(fe_neg(p[..., 0, :]), p[..., 1, :], p[..., 2, :], fe_neg(p[..., 3, :]))
+    x, y, z, t = p
+    return (fe_neg(x), y, z, fe_neg(t))
 
 
 # ---------------------------------------------------------------------------
-# Decompression and verification (single signature; vmapped below).
+# Decompression and batched verification (limb-major, batch in the lanes).
 # ---------------------------------------------------------------------------
 
 
 def decompress(y_limbs, sign):
-    """Recover x from a (reduced-form) y and sign bit. Returns (point, valid)."""
+    """Recover x from canonical y [NLIMB, B] and sign [B]. Returns (point,
+    valid[B])."""
     y2 = fe_sq(y_limbs)
     u = fe_sub(y2, jnp.asarray(_ONE))
     v = fe_add(fe_mul(y2, jnp.asarray(_D)), jnp.asarray(_ONE))
@@ -306,66 +312,86 @@ def decompress(y_limbs, sign):
     correct = fe_eq(vx2, u)
     flipped = fe_eq(vx2, fe_neg(u))
     valid = correct | flipped
-    x = jnp.where(flipped[..., None], fe_mul(x, jnp.asarray(_SQRT_M1)), x)
+    x = jnp.where(flipped, fe_mul(x, jnp.asarray(_SQRT_M1)), x)
     x_can = fe_canonical(x)
-    x_zero = jnp.all(x_can == 0, axis=-1)
+    x_zero = jnp.all(x_can == 0, axis=0)
     valid = valid & ~(x_zero & (sign == 1))
-    parity = x_can[..., 0] & 1
-    x = jnp.where((parity != sign)[..., None], fe_neg(x), x)
-    point = pt(x, y_limbs, jnp.asarray(_ONE), fe_mul(x, y_limbs))
-    return point, valid
+    parity = x_can[0] & 1
+    x = jnp.where(parity != sign, fe_neg(x), x)
+    one = jnp.zeros_like(x).at[0].set(1)
+    return (x, y_limbs, one, fe_mul(x, y_limbs)), valid
 
 
-def _table_entry_affine(table, digit):
-    """Extended point from an affine (x, y, t) table row; identity-safe
-    because row 0 is (0, 1, 0) and Z is forced to 1."""
-    row = jnp.take(table, digit, axis=0)  # [3, NLIMB]
-    return pt(row[0], row[1], jnp.asarray(_ONE), row[2])
+def _select(table, digit):
+    """table [16, NLIMB, B], digit [B] -> [NLIMB, B] (per-lane row select)."""
+    onehot = (digit[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(
+        jnp.int32
+    )  # [16, B]
+    return jnp.einsum("tlb,tb->lb", table, onehot)
 
 
-def verify_one(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
-    """Cofactorless check: encode([S]B + [k](-A)) == (r_y, r_sign).
+def _select_const(table, digit):
+    """table [16, NLIMB] (host constant), digit [B] -> [NLIMB, B]."""
+    onehot = (digit[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(
+        jnp.int32
+    )
+    return jnp.einsum("tl,tb->lb", jnp.asarray(table), onehot)
 
-    a_y/r_y: int32[NLIMB] reduced-form y coordinates (canonical, from host);
-    *_sign: int32 scalars; k_digits/s_digits: int32[WINDOWS] 4-bit digits,
-    MSB first. Returns bool.
+
+@jax.jit
+def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
+    """Cofactorless check per lane: encode([S]B + [k](-A)) == (r_y, r_sign).
+
+    Host-facing shapes (batch-leading): a_y/r_y int32[B, NLIMB] canonical y
+    limbs; a_sign/r_sign int32[B]; k_digits/s_digits int32[B, 64] 4-bit
+    digits MSB-first. Returns bool[B].
     """
+    a_y = a_y.T  # -> limb-major [NLIMB, B]
+    r_y = r_y.T
+    k_digits = k_digits.T  # -> [64, B]
+    s_digits = s_digits.T
+    B = a_y.shape[1]
+
     a_point, valid = decompress(a_y, a_sign)
     neg_a = pt_neg(a_point)
 
-    # 16 multiples of -A (device); 16 multiples of B (host constant).
+    # 16 multiples of -A built on device; 16 multiples of B from the host.
     def next_multiple(prev, _):
         nxt = pt_add(prev, neg_a)
         return nxt, nxt
 
-    _, higher = lax.scan(next_multiple, neg_a, None, length=14)  # 2A..15A
-    table_a = jnp.concatenate(
-        [pt_identity()[None], neg_a[None], higher], axis=0
-    )  # [16, 4, NLIMB]
-    table_b = jnp.asarray(_BT)  # [16, 3, NLIMB]
+    _, higher = lax.scan(next_multiple, neg_a, None, length=14)  # [14, ...] x4
+    ident = pt_identity((B,))
+    table_a = tuple(
+        jnp.concatenate([ident[i][None], neg_a[i][None], higher[i]], axis=0)
+        for i in range(4)
+    )  # 4 coords, each [16, NLIMB, B]
+
+    one = ident[1]
 
     def step(acc, digits):
         kd, sd = digits
         for _ in range(4):
             acc = pt_double(acc)
-        acc = pt_add(acc, jnp.take(table_a, kd, axis=0))
-        acc = pt_add(acc, _table_entry_affine(table_b, sd))
+        qa = tuple(_select(table_a[i], kd) for i in range(4))
+        acc = pt_add(acc, qa)
+        qb = (
+            _select_const(_BT[:, 0], sd),
+            _select_const(_BT[:, 1], sd),
+            one,
+            _select_const(_BT[:, 2], sd),
+        )
+        acc = pt_add(acc, qb)
         return acc, None
 
-    acc, _ = lax.scan(step, pt_identity(), (k_digits, s_digits))
+    acc, _ = lax.scan(step, ident, (k_digits, s_digits))
 
     zinv = fe_invert(acc[2])
     x = fe_mul(acc[0], zinv)
     y = fe_mul(acc[1], zinv)
     x_can = fe_canonical(x)
-    ok = fe_eq(y, r_y) & ((x_can[..., 0] & 1) == r_sign)
+    ok = fe_eq(y, r_y) & ((x_can[0] & 1) == r_sign)
     return ok & valid
-
-
-@functools.partial(jax.jit, static_argnames=())
-def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
-    """[B]-batched verification; every argument's leading axis is the batch."""
-    return jax.vmap(verify_one)(a_y, a_sign, r_y, r_sign, k_digits, s_digits)
 
 
 # ---------------------------------------------------------------------------

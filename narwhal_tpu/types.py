@@ -181,9 +181,12 @@ class Header:
             author, round, epoch, dict(payload), frozenset(parents), signer.sign(h.digest)
         )
 
-    def verify(self, committee, worker_cache) -> None:
+    def verify(self, committee, worker_cache, check_signature: bool = True) -> None:
         """Mirrors Header::verify (/root/reference/types/src/primary.rs:180-233):
-        epoch, authority known + has stake, worker ids valid, signature."""
+        epoch, authority known + has stake, worker ids valid, signature.
+        `check_signature=False` runs only the structural checks — callers
+        batching signatures elsewhere (the TPU verification stage) use it
+        together with `signature_item()`."""
         if self.epoch != committee.epoch:
             raise InvalidEpoch(f"header epoch {self.epoch} != {committee.epoch}")
         if committee.stake(self.author) == 0:
@@ -191,8 +194,12 @@ class Header:
         for digest, worker_id in self.payload.items():
             if not worker_cache.has_worker(self.author, worker_id):
                 raise UnknownWorker(f"worker {worker_id} not in cache")
-        if not verify(self.author, self.digest, self.signature):
+        if check_signature and not verify(self.author, self.digest, self.signature):
             raise InvalidSignatureError("bad header signature")
+
+    def signature_item(self) -> tuple[bytes, bytes, bytes]:
+        """(pubkey, message, signature) for batch verification."""
+        return (self.author, self.digest, self.signature)
 
 
 # ---------------------------------------------------------------------------
@@ -248,14 +255,18 @@ class Vote:
             v.header_digest, v.round, v.epoch, v.origin, v.author, signer.sign(v.digest)
         )
 
-    def verify(self, committee) -> None:
+    def verify(self, committee, check_signature: bool = True) -> None:
         """Vote::verify (/root/reference/types/src/primary.rs:344-371)."""
         if self.epoch != committee.epoch:
             raise InvalidEpoch(f"vote epoch {self.epoch} != {committee.epoch}")
         if committee.stake(self.author) == 0:
             raise DagError(f"unknown voter {self.author.hex()[:16]}")
-        if not verify(self.author, self.digest, self.signature):
+        if check_signature and not verify(self.author, self.digest, self.signature):
             raise InvalidSignatureError("bad vote signature")
+
+    def signature_item(self) -> tuple[bytes, bytes, bytes]:
+        """(pubkey, message, signature) for batch verification."""
+        return (self.author, self.digest, self.signature)
 
 
 def vote_digest(

@@ -105,3 +105,57 @@ def test_node_restart_recovers_from_store(run, tmp_path):
             await cluster.shutdown()
 
     run(scenario(), timeout=120.0)
+
+
+def test_cluster_with_verification_pool(run):
+    """crypto_backend="pool": the async pre-verification stage (coalesced
+    batch verification off the Core's loop) must preserve liveness and
+    ordering; a forged certificate must still be rejected."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1, crypto_backend="pool")
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            target = cluster.authorities[0].worker_transactions_address(0)
+            txs = tuple(bytes([9]) * 16 + bytes([i]) for i in range(32))
+            await client.request(target, SubmitTransactionStreamMsg(txs))
+
+            # Forge a certificate with garbage signatures at node 1.
+            from dataclasses import replace as dreplace
+
+            from narwhal_tpu.fixtures import mock_certificate
+            from narwhal_tpu.messages import CertificateMsg
+            from narwhal_tpu.types import Certificate
+
+            genesis = {
+                c.digest for c in Certificate.genesis(cluster.committee)
+            }
+            # Unique payload so the forged digest cannot collide with any
+            # legitimately produced certificate.
+            forged = mock_certificate(
+                cluster.committee,
+                cluster.authorities[0].name,
+                1,
+                genesis,
+                payload={b"\xab" * 32: 0},
+            )
+            forged = dreplace(
+                forged,
+                signers=(0, 1, 2),
+                signatures=(b"\x00" * 64, b"\x01" * 64, b"\x02" * 64),
+            )
+            await client.unreliable_send(
+                cluster.authorities[1].primary.address, CertificateMsg(forged)
+            )
+
+            rounds = await cluster.assert_progress(commit_threshold=3, timeout=30.0)
+            assert all(r >= 3 for r in rounds.values())
+            assert not cluster.authorities[1].primary.storage.certificate_store.contains(
+                forged.digest
+            )
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)

@@ -1,0 +1,96 @@
+"""Epoch change / reconfiguration, mirroring
+/root/reference/primary/tests/epoch_change.rs (in-band NewEpoch liveness) and
+/root/reference/node/tests/reconfigure.rs (NodeRestarter-driven change)."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.cluster import Cluster
+from narwhal_tpu.messages import ReconfigureMsg
+from narwhal_tpu.network import NetworkClient
+
+
+async def _wait_epoch_progress(cluster, epoch, min_round, timeout=30.0):
+    """Wait until every running primary holds a certificate of `epoch` at or
+    past `min_round` (the reference's rx_new_certificates round-10 wait)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        done = 0
+        for a in cluster.authorities:
+            if a.primary is None:
+                continue
+            store = a.primary.storage.certificate_store
+            certs = store.after_round(max(1, min_round))
+            if any(c.epoch == epoch and c.round >= min_round for c in certs):
+                done += 1
+        running = sum(1 for a in cluster.authorities if a.primary is not None)
+        if done == running:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"epoch {epoch} never reached round {min_round} on all nodes "
+                f"({done}/{running})"
+            )
+        await asyncio.sleep(0.1)
+
+
+def test_in_band_epoch_change(run):
+    """Send NewEpoch reconfigure messages to every primary: the whole
+    committee must restart its DAG in the new epoch and keep producing
+    certificates (epoch_change.rs simple_epoch_change)."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1)
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=30.0)
+            for epoch in (1, 2):
+                new_committee = cluster.committee.to_json()
+                import json
+
+                doc = json.loads(new_committee)
+                doc["epoch"] = epoch
+                msg = ReconfigureMsg("new_epoch", json.dumps(doc))
+                for a in cluster.authorities:
+                    await client.unreliable_send(a.primary.address, msg)
+                await _wait_epoch_progress(cluster, epoch, 6, timeout=30.0)
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=120.0)
+
+
+def test_worker_scale_out(run):
+    """Two workers per authority: both lanes carry batches into headers and
+    the committee commits transactions submitted to distinct lanes
+    (SURVEY §2.14 worker sharding)."""
+
+    async def scenario():
+        from narwhal_tpu.messages import SubmitTransactionStreamMsg
+
+        cluster = Cluster(size=4, workers=2)
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            for wid in (0, 1):
+                target = cluster.authorities[0].worker_transactions_address(wid)
+                txs = tuple(bytes([wid]) * 24 + bytes([i]) for i in range(16))
+                await client.request(target, SubmitTransactionStreamMsg(txs))
+
+            got = []
+            details = cluster.authorities[1]
+            while len(got) < 32:
+                _, tx = await asyncio.wait_for(
+                    details.primary.tx_execution_output.recv(), 30.0
+                )
+                got.append(tx)
+            # transactions from both worker lanes were ordered and executed
+            assert any(tx[0] == 0 for tx in got) and any(tx[0] == 1 for tx in got)
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)

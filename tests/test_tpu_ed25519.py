@@ -37,7 +37,7 @@ def test_point_ops_match_reference():
     import jax.numpy as jnp
 
     def to_ext(p):
-        return jnp.asarray(np.stack([k.int_to_limbs(c) for c in p]))
+        return tuple(jnp.asarray(k.int_to_limbs(c)) for c in p)
 
     def from_ext(e):
         return tuple(k.limbs_to_int(k.fe_canonical(e[i])) for i in range(4))
@@ -52,7 +52,9 @@ def test_point_ops_match_reference():
 
 @pytest.fixture(scope="module")
 def verifier():
-    return TpuVerifier()
+    # One small bucket => one XLA compile for the whole test module (the
+    # CPU-backend compile dominates test wall-clock otherwise).
+    return TpuVerifier(max_bucket=16)
 
 
 def test_batch_verify_valid_and_corrupted(verifier):
