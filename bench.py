@@ -19,7 +19,7 @@ import json
 import os
 import time
 
-BATCH = 2048
+BATCH = 8192
 ROUNDS = 4
 
 

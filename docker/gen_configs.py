@@ -1,0 +1,47 @@
+"""Generate committee/worker/key/parameter files for the docker-compose
+localnet (reference: benchmark config generation, adapted to service DNS
+names instead of localhost ports)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu.config import Authority, Committee, Parameters, WorkerCache, WorkerInfo
+from narwhal_tpu.crypto import KeyPair
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "configs")
+N = 4
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    keypairs = [KeyPair.generate() for _ in range(N)]
+    authorities = {}
+    workers = {}
+    for i, kp in enumerate(keypairs):
+        with open(f"{OUT}/key-{i}.json", "w") as f:
+            json.dump({"name": kp.public.hex(), "seed": kp.private_bytes().hex()}, f)
+        authorities[kp.public] = Authority(
+            stake=1,
+            primary_address=f"primary-{i}:4000",
+            network_key=kp.public,
+        )
+        workers[kp.public] = {
+            0: WorkerInfo(
+                name=kp.public,
+                transactions=f"worker-{i}:4001",
+                worker_address=f"worker-{i}:4002",
+            )
+        }
+    Committee(authorities).export(f"{OUT}/committee.json")
+    WorkerCache(workers).export(f"{OUT}/workers.json")
+    Parameters().export(f"{OUT}/parameters.json")
+    print(f"wrote configs for {N} validators to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -222,6 +222,25 @@ class PrimaryNode:
         self.api_address = await self.api.spawn(
             self.parameters.consensus_api_grpc_address
         )
+        # Restart catch-up (block_synchronizer/mod.rs:75-83 SynchronizeRange):
+        # collect certificates peers accumulated while we were down.
+        last_round = self.storage.certificate_store.last_round()
+        if last_round > 0:
+            async def catch_up() -> None:
+                try:
+                    fetched = await self.block_synchronizer.synchronize_range(
+                        last_round
+                    )
+                    if fetched:
+                        logger.info(
+                            "Catch-up: fetched %d certificates past round %d",
+                            len(fetched),
+                            last_round,
+                        )
+                except Exception:
+                    logger.debug("restart catch-up failed", exc_info=True)
+
+            self._tasks.append(asyncio.ensure_future(catch_up()))
 
     async def shutdown(self) -> None:
         for t in self._tasks:

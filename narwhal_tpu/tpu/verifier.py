@@ -32,7 +32,7 @@ from ..crypto import BatchItem
 logger = logging.getLogger("narwhal.tpu.verifier")
 
 _MIN_BUCKET = 16
-_MAX_BUCKET = 4096
+_MAX_BUCKET = 8192
 
 
 class TpuVerifier:
