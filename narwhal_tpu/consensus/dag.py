@@ -165,17 +165,29 @@ class Dag:
         coordinate index; unknown digests error, already-dropped are fine."""
         async with self._lock:
             unknown: list[Digest] = []
+            removed: list[Digest] = []
             todrop = set(digests)
             for digest in todrop:
                 try:
                     self._dag.make_compressible(digest)
+                    removed.append(digest)
                 except UnknownDigests:
                     unknown.append(digest)
                 except DroppedDigest:
-                    pass
+                    removed.append(digest)
             self._vertices = {
                 k: v for k, v in self._vertices.items() if v not in todrop
             }
+            # A digest actually removed will never be inserted again: fail its
+            # waiters now rather than leaving futures pending forever. Unknown
+            # digests are NOT failed — they were not removed and may still be
+            # inserted later by the feed.
+            for digest in removed:
+                for fut in self._obligations.pop(digest, []):
+                    if not fut.done():
+                        fut.set_exception(
+                            ValidatorDagError(f"{digest!r} was removed")
+                        )
             if unknown:
                 raise ValidatorDagError(f"unknown digests {unknown!r}")
 
@@ -188,7 +200,19 @@ class Dag:
             except UnknownDigests:
                 fut = asyncio.get_running_loop().create_future()
                 self._obligations[digest].append(fut)
+                # Prune cancelled waiters so the map cannot grow unboundedly
+                # with digests that never arrive.
+                fut.add_done_callback(lambda f, d=digest: self._prune_obligation(d, f))
         return await fut
+
+    def _prune_obligation(self, digest: Digest, fut: asyncio.Future) -> None:
+        waiters = self._obligations.get(digest)
+        if waiters is None:
+            return
+        if fut in waiters:
+            waiters.remove(fut)
+        if not waiters:
+            self._obligations.pop(digest, None)
 
     def size(self) -> int:
         return self._dag.size()

@@ -88,7 +88,10 @@ class ExecutorCore:
         """(core.rs:129-259). `batches` is the subscriber's in-memory staging;
         the temp store is only a fallback (e.g. crash replay paths)."""
         certificate = output.certificate
-        payload = list(certificate.header.payload.items())
+        # Sorted by batch digest: matches the canonical wire order so every
+        # node (author included, before and after a crash) executes batches
+        # identically regardless of local dict insertion order.
+        payload = sorted(certificate.header.payload.items())
         total_batches = len(payload)
         for batch_index, (digest, _worker_id) in enumerate(payload):
             if batch_index < self.execution_indices.next_batch_index:

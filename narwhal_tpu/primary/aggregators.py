@@ -48,17 +48,21 @@ class CertificatesAggregator:
         self.weight = 0
         self.certificates: list[Certificate] = []
         self.seen: set[bytes] = set()  # origins
-        self.done = False
 
     def append(
         self, certificate: Certificate, committee: Committee
     ) -> list[Certificate] | None:
-        if self.done or certificate.origin in self.seen:
+        if certificate.origin in self.seen:
             return None
         self.seen.add(certificate.origin)
         self.certificates.append(certificate)
         self.weight += committee.stake(certificate.origin)
         if self.weight >= committee.quorum_threshold():
-            self.done = True
-            return list(self.certificates)
+            # Deliberately keep the accumulated weight: certificates arriving
+            # after the quorum (e.g. the leader's) are each drained and
+            # forwarded too — Bullshark's leader linkage depends on late
+            # parents reaching the proposer (aggregators.rs:83-97).
+            drained = self.certificates
+            self.certificates = []
+            return drained
         return None

@@ -123,14 +123,17 @@ class Core:
             if self.metrics is not None:
                 self.metrics.headers_suspended.inc()
             return
-        if not header.parents.issubset(self.synchronizer.genesis_digests):
-            stake = sum(self.committee.stake(p.origin) for p in parents)
-            if any(p.round + 1 != header.round for p in parents):
-                raise DagError(f"header {header.digest.hex()[:16]} has malformed parents")
-            if stake < self.committee.quorum_threshold():
-                raise DagError(
-                    f"header {header.digest.hex()[:16]} lacks parent quorum"
-                )
+        # Always run the round-match and stake-quorum checks — genesis
+        # certificates count toward the quorum like any parent
+        # (synchronizer.rs:119-125, core.rs:214-231). An empty parent set
+        # yields zero stake and is rejected here, never voted for.
+        stake = sum(self.committee.stake(p.origin) for p in parents)
+        if any(p.round + 1 != header.round for p in parents):
+            raise DagError(f"header {header.digest.hex()[:16]} has malformed parents")
+        if stake < self.committee.quorum_threshold():
+            raise DagError(
+                f"header {header.digest.hex()[:16]} lacks parent quorum"
+            )
 
         # Payload availability (core.rs:233-246).
         if await self.synchronizer.missing_payload(header):

@@ -90,15 +90,14 @@ class Primary:
         self.tx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
         self.tx_consensus_round_updates: Watch = Watch(0)
 
-        genesis_digests = frozenset(
-            c.digest for c in Certificate.genesis(committee)
-        )
+        genesis = {c.digest: c for c in Certificate.genesis(committee)}
+        genesis_digests = frozenset(genesis)
         self.synchronizer = Synchronizer(
             name,
             storage.certificate_store,
             storage.payload_store,
             self.tx_sync_headers,
-            genesis_digests,
+            genesis,
         )
         self.helper = Helper(
             committee, storage.certificate_store, storage.payload_store

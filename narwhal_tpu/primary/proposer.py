@@ -177,11 +177,17 @@ class Proposer:
                 if parents_task in done:
                     parents, round_, epoch = parents_task.result()
                     parents_task = asyncio.ensure_future(self.rx_core.recv())
-                    if epoch == self.committee.epoch and round_ >= self.round:
-                        # Jump to the parents' round: propose on top of them
-                        # (proposer.rs:254-282).
-                        self.round = round_
-                        self.last_parents = parents
+                    if epoch == self.committee.epoch:
+                        if round_ > self.round:
+                            # Jump to the parents' round: propose on top of
+                            # them (proposer.rs:254-282).
+                            self.round = round_
+                            self.last_parents = parents
+                        elif round_ == self.round:
+                            # Post-quorum stragglers for the current round
+                            # (e.g. the leader's certificate) extend the
+                            # parent set rather than replace it.
+                            self.last_parents.extend(parents)
                 if digest_task in done:
                     digest, worker_id = digest_task.result()
                     digest_task = asyncio.ensure_future(self.rx_workers.recv())

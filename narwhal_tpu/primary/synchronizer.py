@@ -41,20 +41,20 @@ class Synchronizer:
         certificate_store: CertificateStore,
         payload_store: PayloadStore,
         tx_header_waiter: Channel,
-        genesis_digests: frozenset[Digest],
+        genesis: dict[Digest, Certificate],
     ):
         self.name = name
         self.certificate_store = certificate_store
         self.payload_store = payload_store
         self.tx_header_waiter = tx_header_waiter
-        self.genesis_digests = genesis_digests
+        self.genesis = dict(genesis)
+        self.genesis_digests = frozenset(genesis)
 
     def update_genesis(self, committee) -> None:
         """Genesis digests embed the epoch; recompute them on reconfiguration
         or round-1 headers of the new epoch would suspend forever."""
-        self.genesis_digests = frozenset(
-            c.digest for c in Certificate.genesis(committee)
-        )
+        self.genesis = {c.digest: c for c in Certificate.genesis(committee)}
+        self.genesis_digests = frozenset(self.genesis)
 
     async def missing_payload(self, header: Header) -> bool:
         """True if some batch of the header isn't locally available yet; queues
@@ -74,11 +74,16 @@ class Synchronizer:
 
     async def get_parents(self, header: Header) -> list[Certificate] | None:
         """The parent certificates, or None (repair queued) if any is missing
-        (synchronizer.rs:115-144). Genesis digests satisfy round-1 headers."""
+        (synchronizer.rs:115-144). Genesis certificates are returned like any
+        other parent (synchronizer.rs:119-125) so the caller's round-match and
+        stake-quorum checks always run — an empty or sub-quorum genesis parent
+        set must be rejected, not silently voted for."""
         parents: list[Certificate] = []
         missing: list[Digest] = []
         for digest in header.parents:
-            if digest in self.genesis_digests:
+            genesis_cert = self.genesis.get(digest)
+            if genesis_cert is not None:
+                parents.append(genesis_cert)
                 continue
             cert = self.certificate_store.read(digest)
             if cert is None:

@@ -175,10 +175,16 @@ class Header:
         signer,
     ) -> "Header":
         """Reference Header::new signs via the SignatureService
-        (/root/reference/types/src/primary.rs:130-148)."""
-        h = Header(author, round, epoch, dict(payload), frozenset(parents))
+        (/root/reference/types/src/primary.rs:130-148).
+
+        The payload is canonicalized (sorted by batch digest) at construction
+        so local iteration order matches the wire encoding (Writer.sorted_map)
+        — executors on every node, including the author and its post-crash
+        replay, walk batches in the same order."""
+        canonical = dict(sorted(payload.items()))
+        h = Header(author, round, epoch, canonical, frozenset(parents))
         return Header(
-            author, round, epoch, dict(payload), frozenset(parents), signer.sign(h.digest)
+            author, round, epoch, canonical, frozenset(parents), signer.sign(h.digest)
         )
 
     def verify(self, committee, worker_cache, check_signature: bool = True) -> None:

@@ -217,6 +217,58 @@ class TestDagService:
 
         run(scenario())
 
+    def test_notify_read_fails_on_remove_and_prunes_cancelled(self, run):
+        """Removed digests fail their waiters instead of leaving futures
+        pending forever, and cancelled waiters are pruned from the
+        obligations map (ADVICE r1)."""
+
+        async def scenario():
+            f = CommitteeFixture(size=4)
+            genesis = {c.digest for c in Certificate.genesis(f.committee)}
+            from narwhal_tpu.fixtures import mock_certificate
+
+            keys = f.committee.authority_keys()
+            payload = {b"\x03" * 32: 0}
+            cert = mock_certificate(f.committee, keys[0], 1, genesis, payload=payload)
+            dag = Dag(f.committee)
+            await dag.insert(cert)
+            waiter = asyncio.ensure_future(dag.notify_read(cert.digest))
+            got = await asyncio.wait_for(waiter, 1.0)
+            assert got.digest == cert.digest
+            # Waiter for a digest that then gets removed -> fails fast.
+            other = mock_certificate(f.committee, keys[1], 1, genesis, payload=payload)
+            await dag.insert(other)
+            pending = asyncio.ensure_future(dag.notify_read(b"\x0f" * 32))
+            await asyncio.sleep(0.01)
+            # remove() raises on the unknown digest; its waiter stays pending
+            # (the feed may still insert it later), while the actually-removed
+            # digest's slot is cleared.
+            with pytest.raises(ValidatorDagError):
+                await dag.remove([b"\x0f" * 32, other.digest])
+            await asyncio.sleep(0.01)
+            assert not pending.done()
+            pending.cancel()
+            await asyncio.sleep(0.01)
+            assert b"\x0f" * 32 not in dag._obligations
+            # White-box: a waiter parked on a digest that IS removed gets
+            # failed (in the public flow inserts resolve waiters first, so
+            # this guards the defensive path directly).
+            victim = mock_certificate(f.committee, keys[2], 1, genesis, payload=payload)
+            await dag.insert(victim)
+            parked = asyncio.get_running_loop().create_future()
+            dag._obligations[victim.digest].append(parked)
+            await dag.remove([victim.digest])
+            assert isinstance(parked.exception(), ValidatorDagError)
+            assert victim.digest not in dag._obligations
+            # Cancelled waiters are pruned.
+            never = asyncio.ensure_future(dag.notify_read(b"\x0e" * 32))
+            await asyncio.sleep(0.01)
+            never.cancel()
+            await asyncio.sleep(0.01)
+            assert b"\x0e" * 32 not in dag._obligations
+
+        run(scenario())
+
     def test_feed_from_channel(self, run):
         async def scenario():
             f, certs = _dag_with_rounds(3)

@@ -42,6 +42,100 @@ def test_votes_aggregator_rejects_duplicate_voter():
     assert agg.weight == 1
 
 
+def test_certificates_aggregator_forwards_post_quorum(run):
+    """Certificates arriving after the round's quorum (e.g. the leader's) are
+    still drained and forwarded so the proposer can extend its parent set
+    (aggregators.rs:83-97, required by Bullshark)."""
+    from narwhal_tpu.primary.aggregators import CertificatesAggregator
+
+    f = CommitteeFixture(size=4)
+    certs = [f.certificate(f.header(author=i, round=1)) for i in range(4)]
+    agg = CertificatesAggregator()
+    assert agg.append(certs[0], f.committee) is None
+    assert agg.append(certs[1], f.committee) is None
+    first = agg.append(certs[2], f.committee)
+    assert first is not None and len(first) == 3
+    late = agg.append(certs[3], f.committee)
+    assert late == [certs[3]]
+    # Duplicates still dropped after quorum.
+    assert agg.append(certs[3], f.committee) is None
+
+
+def _make_core(f, authority_index=0):
+    """A bare Core wired to fresh stores and dummy channels, for direct
+    process_header checks (no networking)."""
+    from narwhal_tpu.primary.core import Core
+    from narwhal_tpu.primary.synchronizer import Synchronizer
+
+    a = f.authorities[authority_index]
+    storage = NodeStorage(None)
+    genesis = {c.digest: c for c in Certificate.genesis(f.committee)}
+    sync = Synchronizer(
+        a.public,
+        storage.certificate_store,
+        storage.payload_store,
+        Channel(100),
+        genesis,
+    )
+    return Core(
+        a.public,
+        f.committee,
+        f.worker_cache,
+        storage.header_store,
+        storage.certificate_store,
+        storage.vote_digest_store,
+        sync,
+        a.signature_service(),
+        network=None,
+        rx_primaries=Channel(10),
+        rx_header_waiter=Channel(10),
+        rx_certificate_waiter=Channel(10),
+        rx_proposer=Channel(10),
+        tx_consensus=Channel(10),
+        tx_proposer=Channel(10),
+        rx_consensus_round_updates=Watch(0),
+        gc_depth=50,
+        rx_reconfigure=Watch(ReconfigureNotification("boot")),
+    )
+
+
+def test_core_rejects_empty_parent_header(run):
+    """A header with no parents must never be voted for: zero parent stake
+    fails the quorum check (ADVICE r1: genesis-subset headers skipped it)."""
+    from narwhal_tpu.types import DagError
+
+    f = CommitteeFixture(size=4)
+
+    async def scenario():
+        core = _make_core(f)
+        header = f.header(author=1, round=1, parents=set())
+        with pytest.raises(DagError):
+            await core.process_header(header)
+
+    run(scenario())
+
+
+def test_core_rejects_sub_quorum_genesis_parents(run):
+    """Genesis parents count toward the stake quorum like any others; a
+    single genesis parent (stake 1 of 4, quorum 3) is rejected."""
+    from narwhal_tpu.types import DagError
+
+    f = CommitteeFixture(size=4)
+    genesis = Certificate.genesis(f.committee)
+
+    async def scenario():
+        core = _make_core(f)
+        header = f.header(author=1, round=1, parents={genesis[0].digest})
+        with pytest.raises(DagError):
+            await core.process_header(header)
+        # The full genesis set still passes (round-1 headers are voteable);
+        # the author's own round-1 header reaches the vote path.
+        ok = f.header(author=0, round=1)
+        await core.process_header(ok)
+
+    run(scenario())
+
+
 def test_proposer_makes_genesis_header(run):
     """The proposer emits a round-1 header on top of genesis
     (proposer_tests.rs propose_empty)."""
