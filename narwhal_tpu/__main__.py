@@ -144,6 +144,31 @@ def main(argv: list[str] | None = None) -> None:
 
     args = parser.parse_args(argv)
     _setup_logging(args.verbose)
+    # NARWHAL_PROFILE=<dir>: dump cProfile stats per process on exit — the
+    # profiling plane (the reference's dhat/pprof analog, node/src/lib.rs:224).
+    import os
+
+    profile_dir = os.environ.get("NARWHAL_PROFILE")
+    profiler = None
+    if profile_dir:
+        import atexit
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+        role = getattr(args, "role", args.command)
+        out = os.path.join(profile_dir, f"{role}-{os.getpid()}.pstats")
+
+        def _dump():
+            profiler.disable()
+            profiler.dump_stats(out)
+
+        atexit.register(_dump)
+        # atexit only runs on clean exit; the bench harness stops nodes with
+        # SIGTERM, so convert it into a normal interpreter exit.
+        import signal as _signal
+
+        _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(0))
     if args.command == "generate_keys":
         cmd_generate_keys(args)
     elif args.command == "run":

@@ -63,6 +63,17 @@ class Writer:
             enc(self, it)
         return self
 
+    def bytes_seq(self, items) -> "Writer":
+        """Sequence of byte strings, same wire form as seq(..., bytes) but
+        without per-item closure dispatch — the transaction hot path."""
+        pack = _U32.pack
+        append = self._parts.append
+        append(pack(len(items)))
+        for b in items:
+            append(pack(len(b)))
+            append(b)
+        return self
+
     def sorted_map(self, mapping, enc_key, enc_val) -> "Writer":
         """Maps are encoded sorted by raw key so encoding is canonical."""
         items = sorted(mapping.items())
@@ -73,6 +84,8 @@ class Writer:
         return self
 
     def finish(self) -> bytes:
+        if len(self._parts) == 1:
+            return self._parts[0]  # zero-copy for raw single-part bodies
         return b"".join(self._parts)
 
 
@@ -121,6 +134,32 @@ class Reader:
             # maliciously huge length prefixes.
             raise CodecError(f"sequence length {n} exceeds remaining input")
         return [dec(self) for _ in range(n)]
+
+    def bytes_seq(self) -> list:
+        """Counterpart of Writer.bytes_seq: decode without per-item closures."""
+        n = self.u32()
+        buf, pos, end = self._buf, self._pos, len(self._buf)
+        if n > end - pos:
+            raise CodecError(f"sequence length {n} exceeds remaining input")
+        unpack = _U32.unpack_from
+        out = []
+        for _ in range(n):
+            if pos + 4 > end:
+                raise CodecError("truncated byte-sequence length")
+            (size,) = unpack(buf, pos)
+            pos += 4
+            if pos + size > end:
+                raise CodecError("truncated byte-sequence element")
+            out.append(buf[pos : pos + size])
+            pos += size
+        self._pos = pos
+        return out
+
+    def rest(self) -> bytes:
+        """Take everything remaining (raw-passthrough payloads)."""
+        out = self._buf[self._pos :]
+        self._pos = len(self._buf)
+        return out
 
     def map(self, dec_key, dec_val) -> dict:
         n = self.u32()

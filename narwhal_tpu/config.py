@@ -16,7 +16,7 @@ import socket
 from dataclasses import asdict, dataclass, field, replace
 from typing import Mapping
 
-from .crypto import blake2b_256
+from .crypto import digest256
 from .types import Epoch, PublicKey, Round, WorkerId
 
 Stake = int
@@ -123,9 +123,9 @@ class Committee:
     def leader(self, seed: int) -> PublicKey:
         """Stake-weighted deterministic leader
         (/root/reference/config/src/lib.rs:553-567): a seeded PRNG pick
-        weighted by stake. We derive the pick from blake2b(seed) so every
+        weighted by stake. We derive the pick from digest256(seed) so every
         implementation (host Python, JAX kernel) agrees bit-for-bit."""
-        h = blake2b_256(seed.to_bytes(8, "little") + self.epoch.to_bytes(8, "little"))
+        h = digest256(seed.to_bytes(8, "little") + self.epoch.to_bytes(8, "little"))
         ticket = int.from_bytes(h[:8], "little") % self._total_stake
         acc = 0
         for pk in self._keys:

@@ -18,7 +18,7 @@ from ..config import WorkerCache
 from ..messages import RequestBatchMsg, RequestedBatchMsg
 from ..network import NetworkClient, RpcError
 from ..stores import BatchStore
-from ..types import Batch, ConsensusOutput, PublicKey
+from ..types import Batch, ConsensusOutput, PublicKey, serialized_batch_digest
 
 logger = logging.getLogger("narwhal.executor")
 
@@ -63,11 +63,10 @@ class Subscriber:
                 resp: RequestedBatchMsg = await self.network.request(
                     info.worker_address, RequestBatchMsg(digest), timeout=10.0
                 )
-                batch = Batch(resp.transactions)
-                if batch.digest == digest:
-                    self.temp_batch_store.write(digest, batch.to_bytes())
-                    return batch
-                # Worker doesn't have it yet (empty reply) or corrupt: retry.
+                if resp.found and serialized_batch_digest(resp.serialized_batch) == digest:
+                    self.temp_batch_store.write(digest, resp.serialized_batch)
+                    return Batch.from_bytes(resp.serialized_batch)
+                # Worker doesn't have it yet (miss) or corrupt: retry.
             except (RpcError, OSError, KeyError) as e:
                 logger.debug("batch fetch retry for %s: %s", digest.hex()[:16], e)
             await asyncio.sleep(delay)

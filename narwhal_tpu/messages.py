@@ -34,9 +34,20 @@ def message(tag: int):
 
 
 def encode_message(msg) -> tuple[int, bytes]:
+    # Broadcasts and retries encode the same object repeatedly (a batch goes
+    # to every peer and each reliable-send attempt re-encodes); memoize the
+    # wire form on the instance.
+    cached = getattr(msg, "_encoded", None)
+    if cached is not None:
+        return cached
     w = Writer()
     msg.encode(w)
-    return msg.TAG, w.finish()
+    encoded = (msg.TAG, w.finish())
+    try:
+        msg._encoded = encoded
+    except AttributeError:
+        pass  # slotted/frozen types just skip the memo
+    return encoded
 
 
 def decode_message(tag: int, body: bytes):
@@ -374,16 +385,30 @@ class OthersBatchMsg:
 @message(32)
 @dataclass
 class RequestedBatchMsg:
+    """Batch fetch response. Carries the *serialized* batch so the server
+    side never decodes/re-encodes transactions (found=False for a miss);
+    requesters decode once via `transactions`."""
+
     digest: Digest
-    transactions: tuple[bytes, ...]
+    serialized_batch: bytes
+    found: bool = True
 
     def encode(self, w: Writer) -> None:
         w.raw(self.digest)
-        w.seq(self.transactions, lambda w_, t: w_.bytes(t))
+        w.u8(1 if self.found else 0)
+        w.bytes(self.serialized_batch)
 
     @staticmethod
     def decode(r: Reader) -> "RequestedBatchMsg":
-        return RequestedBatchMsg(_dec_digest(r), tuple(r.seq(lambda r_: r_.bytes())))
+        digest = _dec_digest(r)
+        found = r.u8() == 1
+        return RequestedBatchMsg(digest, r.bytes(), found)
+
+    @property
+    def transactions(self) -> tuple[bytes, ...]:
+        if not self.found:
+            return ()
+        return Batch.from_bytes(self.serialized_batch).transactions
 
 
 @message(33)
@@ -422,16 +447,18 @@ class WorkerErrorMsg:
 class WorkerBatchMsg:
     """Batch dissemination. Carries the serialized batch so the receiver can
     digest the wire bytes directly (serialized_batch_digest,
-    types/src/worker.rs:44-62)."""
+    types/src/worker.rs:44-62). The message body IS the serialized batch
+    (no length wrapper): encoding a broadcast is zero-copy — the memoized
+    wire form aliases the batch bytes instead of duplicating them."""
 
     serialized_batch: bytes
 
     def encode(self, w: Writer) -> None:
-        w.bytes(self.serialized_batch)
+        w.raw(self.serialized_batch)
 
     @staticmethod
     def decode(r: Reader) -> "WorkerBatchMsg":
-        return WorkerBatchMsg(r.bytes())
+        return WorkerBatchMsg(r.rest())
 
     def batch(self) -> Batch:
         return Batch.from_bytes(self.serialized_batch)
@@ -485,16 +512,53 @@ class SubmitTransactionMsg:
 @message(51)
 @dataclass
 class SubmitTransactionStreamMsg:
-    """Batched client submission (the streaming variant)."""
+    """Batched client submission (the streaming variant).
 
-    transactions: tuple[bytes, ...]
+    Decoded lazily: the ingest path validates the frames structurally
+    (types.validate_tx_frames) and forwards the undecoded chunk straight into
+    batch sealing — the burst's wire form IS the batch's wire form, so no
+    per-transaction split ever happens on the worker."""
+
+    transactions: tuple[bytes, ...] = ()
+    raw: bytes | None = None  # full wire body: u32 count | frames
 
     def encode(self, w: Writer) -> None:
-        w.seq(self.transactions, lambda w_, t: w_.bytes(t))
+        if self.raw is not None:
+            w.raw(self.raw)
+        else:
+            w.bytes_seq(self.transactions)
 
     @staticmethod
     def decode(r: Reader) -> "SubmitTransactionStreamMsg":
-        return SubmitTransactionStreamMsg(tuple(r.seq(lambda r_: r_.bytes())))
+        return SubmitTransactionStreamMsg((), r.rest())
+
+    @property
+    def count(self) -> int:
+        if self.raw is None:
+            return len(self.transactions)
+        import struct
+
+        (n,) = struct.unpack_from("<I", self.raw, 0)
+        return n
+
+    @property
+    def frames(self) -> bytes:
+        """The per-tx frames without the leading count word."""
+        if self.raw is None:
+            w = Writer()
+            self.encode(w)
+            return w.finish()[4:]
+        return self.raw[4:]
+
+    @property
+    def txs(self) -> tuple[bytes, ...]:
+        """Materialized transactions (tests/low-rate tools only)."""
+        if self.raw is None:
+            return self.transactions
+        r = Reader(self.raw)
+        out = tuple(r.bytes_seq())
+        r.done()
+        return out
 
 
 # ---------------------------------------------------------------------------

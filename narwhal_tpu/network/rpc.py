@@ -80,6 +80,14 @@ def _pack(kind: int, rid: int, tag: int, body: bytes) -> bytes:
     return _FRAME_HDR.pack(len(body), kind, rid, tag) + body
 
 
+def _write_frame(writer: asyncio.StreamWriter, kind: int, rid: int, tag: int, body: bytes) -> None:
+    # Two writes instead of one concatenated buffer: batch frames are large
+    # (hundreds of KB) and the header+body copy showed up at high rates.
+    writer.write(_FRAME_HDR.pack(len(body), kind, rid, tag))
+    if body:
+        writer.write(body)
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, int, int, bytes]:
     hdr = await reader.readexactly(_FRAME_HDR.size)
     length, kind, rid, tag = _FRAME_HDR.unpack(hdr)
@@ -152,7 +160,7 @@ class PeerClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         try:
-            self._writer.write(_pack(KIND_REQ, rid, tag, body))
+            _write_frame(self._writer, KIND_REQ, rid, tag, body)
             await self._writer.drain()
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError) as e:
@@ -238,13 +246,13 @@ class RpcServer:
             if resp is None:
                 resp = Ack()
             rtag, rbody = encode_message(resp)
-            frame = _pack(KIND_RESP, rid, rtag, rbody)
+            out = (KIND_RESP, rid, rtag, rbody)
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            frame = _pack(KIND_ERR, rid, 0, str(e).encode())
+            out = (KIND_ERR, rid, 0, str(e).encode())
         try:
-            writer.write(frame)
+            _write_frame(writer, *out)
             await writer.drain()
         except (ConnectionError, OSError):
             pass

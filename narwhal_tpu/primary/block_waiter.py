@@ -18,7 +18,7 @@ from ..config import WorkerCache
 from ..messages import RequestBatchMsg, RequestedBatchMsg
 from ..network import NetworkClient, RpcError
 from ..stores import CertificateStore
-from ..types import Batch, Certificate, Digest, PublicKey
+from ..types import Batch, Certificate, Digest, PublicKey, serialized_batch_digest
 
 logger = logging.getLogger("narwhal.primary")
 
@@ -111,7 +111,6 @@ class BlockWaiter:
         resp: RequestedBatchMsg = await self.network.request(
             info.worker_address, RequestBatchMsg(batch_digest)
         )
-        batch = Batch(resp.transactions)
-        if batch.digest != batch_digest:  # missing (empty reply) or corrupt
+        if not resp.found or serialized_batch_digest(resp.serialized_batch) != batch_digest:
             raise RpcError(f"worker {worker_id} lacks batch {batch_digest.hex()[:16]}")
-        return batch
+        return Batch.from_bytes(resp.serialized_batch)

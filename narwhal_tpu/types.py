@@ -9,7 +9,8 @@ TPU-first deltas from the reference:
     instead of one aggregate BLS signature + roaring bitmap (see crypto.py for
     the rationale); verification is a batch verify over the vote digests —
     the exact shape the TPU verifier consumes.
-  * All digests are blake2b-256 of the canonical codec encoding, so the
+  * All digests are SHA-256 of the canonical codec encoding (crypto.digest256;
+    the reference uses blake2b-256 — see the rationale there), so the
     reference's `serialized_batch_digest` zero-copy optimization
     (/root/reference/types/src/worker.rs:44-62) holds by construction: hashing
     the wire bytes IS hashing the batch.
@@ -17,12 +18,13 @@ TPU-first deltas from the reference:
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass, field
 from functools import cached_property
 from typing import Iterable, Mapping
 
 from .codec import CodecError, Reader, Writer
-from .crypto import DIGEST_LEN, PUBLIC_KEY_LEN, SIGNATURE_LEN, blake2b_256, verify
+from .crypto import DIGEST_LEN, PUBLIC_KEY_LEN, SIGNATURE_LEN, digest256, verify
 
 Digest = bytes  # 32 bytes
 PublicKey = bytes  # 32 bytes
@@ -87,7 +89,7 @@ class Batch:
 
     @cached_property
     def digest(self) -> Digest:
-        return blake2b_256(self.to_bytes())
+        return digest256(self.to_bytes())
 
     @property
     def size_bytes(self) -> int:
@@ -98,7 +100,79 @@ def serialized_batch_digest(wire_bytes: bytes) -> Digest:
     """Digest a serialized batch without deserializing it — the worker receive
     path optimization (/root/reference/types/src/worker.rs:44-62). Valid
     because Batch.digest hashes exactly the canonical wire encoding."""
-    return blake2b_256(wire_bytes)
+    return digest256(wire_bytes)
+
+
+_U32 = struct.Struct("<I")
+
+
+def validate_tx_frames(frames: bytes, count: int) -> None:
+    """Structurally validate a chunk of `count` length-prefixed transactions
+    (the body of a client burst / batch, minus its leading count word).
+
+    Client bursts flow through batching and dissemination in wire form — this
+    walk (two unpacks per tx, no copies) is the only per-transaction work the
+    trusted path does, and it keeps a malformed client chunk from ever
+    reaching a sealed batch (where it would poison executor decode
+    committee-wide)."""
+    pos, end = 0, len(frames)
+    unpack = _U32.unpack_from
+    for _ in range(count):
+        if pos + 4 > end:
+            raise CodecError("truncated transaction chunk")
+        (n,) = unpack(frames, pos)
+        pos += 4 + n
+        if pos > end:
+            raise CodecError("transaction overruns chunk")
+    if pos != end:
+        raise CodecError("trailing bytes in transaction chunk")
+
+
+def assemble_serialized_batch(count: int, frame_parts: list[bytes]) -> bytes:
+    """Concatenate validated tx chunks into a canonical serialized Batch:
+    u32 count | per-tx (u32 len | bytes). Identical bytes to
+    Batch(txs).to_bytes() — the seal path never touches individual
+    transactions."""
+    return _U32.pack(count) + b"".join(frame_parts)
+
+
+def iter_serialized_batch_txs(wire_bytes: bytes):
+    """Yield (offset, length) of each transaction inside a serialized batch
+    without copying — the benchmark sample scan."""
+    (count,) = _U32.unpack_from(wire_bytes, 0)
+    pos = 4
+    unpack = _U32.unpack_from
+    for _ in range(count):
+        (n,) = unpack(wire_bytes, pos)
+        pos += 4
+        yield pos, n
+        pos += n
+
+
+@dataclass(frozen=True)
+class SealedBatch:
+    """A sealed batch in wire form: what the worker pipeline actually moves.
+
+    The reference's BatchMaker hands `Batch` values around and re-serializes
+    at each edge; here the serialized form is the value (sealed once, hashed
+    once, broadcast as-is) and `Batch` is only materialized where individual
+    transactions are needed (the executor)."""
+
+    serialized: bytes
+    count: int
+
+    @cached_property
+    def digest(self) -> Digest:
+        return digest256(self.serialized)
+
+    @property
+    def size_bytes(self) -> int:
+        # Payload bytes excluding the count word and per-tx length prefixes.
+        return len(self.serialized) - 4 - 4 * self.count
+
+    @cached_property
+    def transactions(self) -> tuple[bytes, ...]:
+        return Batch.from_bytes(self.serialized).transactions
 
 
 # ---------------------------------------------------------------------------
@@ -137,7 +211,7 @@ class Header:
     def digest(self) -> Digest:
         w = Writer()
         self._encode_core(w)
-        return blake2b_256(w.finish())
+        return digest256(w.finish())
 
     def encode(self, w: Writer) -> None:
         self._encode_core(w)
@@ -237,7 +311,7 @@ class Vote:
     def digest(self) -> Digest:
         w = Writer()
         self._encode_core(w)
-        return blake2b_256(w.finish())
+        return digest256(w.finish())
 
     def encode(self, w: Writer) -> None:
         self._encode_core(w)
@@ -286,7 +360,7 @@ def vote_digest(
     w.u64(epoch)
     w.raw(origin)
     w.raw(author)
-    return blake2b_256(w.finish())
+    return digest256(w.finish())
 
 
 # ---------------------------------------------------------------------------
@@ -325,7 +399,7 @@ class Certificate:
         w = Writer()
         w.raw(b"CERT")
         w.raw(self.header.digest)
-        return blake2b_256(w.finish())
+        return digest256(w.finish())
 
     def encode(self, w: Writer) -> None:
         self.header.encode(w)

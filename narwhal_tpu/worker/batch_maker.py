@@ -16,7 +16,7 @@ import struct
 import time
 
 from ..channels import Channel, Subscriber, Watch
-from ..types import Batch
+from ..types import SealedBatch, assemble_serialized_batch, iter_serialized_batch_txs
 
 logger = logging.getLogger("narwhal.worker")
 
@@ -39,7 +39,9 @@ class BatchMaker:
         self.rx_reconfigure = Subscriber(rx_reconfigure)
         self.metrics = metrics
         self.benchmark = benchmark
+        # Pending transactions stay in wire form: (frame chunks, tx count).
         self._pending: list[bytes] = []
+        self._pending_count = 0
         self._pending_bytes = 0
 
     def spawn(self) -> asyncio.Task:
@@ -53,11 +55,16 @@ class BatchMaker:
         while True:
             timeout = max(0.0, deadline - time.monotonic())
             try:
-                tx = await asyncio.wait_for(self.rx_transaction.recv(), timeout=timeout)
+                # Receives whole client bursts as (count, frames) chunks in
+                # wire form: one channel hop and zero per-tx work per burst.
+                count, frames = await asyncio.wait_for(
+                    self.rx_transaction.recv(), timeout=timeout
+                )
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
-                self._pending.append(tx)
-                self._pending_bytes += len(tx)
+                self._pending.append(frames)
+                self._pending_count += count
+                self._pending_bytes += len(frames) - 4 * count
                 if self._pending_bytes >= self.batch_size:
                     await self._seal()
                     deadline = time.monotonic() + self.max_batch_delay
@@ -69,19 +76,23 @@ class BatchMaker:
                 deadline = time.monotonic() + self.max_batch_delay
 
     async def _seal(self) -> None:
-        batch = Batch(tuple(self._pending))
+        serialized = assemble_serialized_batch(self._pending_count, self._pending)
+        batch = SealedBatch(serialized, self._pending_count)
         size = self._pending_bytes
         self._pending = []
+        self._pending_count = 0
         self._pending_bytes = 0
         if self.benchmark:
-            digest = batch.digest
-            for tx in batch.transactions:
+            digest_hex = batch.digest.hex()
+            for off, n in iter_serialized_batch_txs(serialized):
                 # Sample txs: first byte 0, u64 counter follows (the
                 # benchmark client's marker, node/src/benchmark_client.rs).
-                if len(tx) >= 9 and tx[0] == 0:
-                    (sample_id,) = struct.unpack_from(">Q", tx, 1)
-                    logger.info("Batch %s contains sample tx %d", digest.hex(), sample_id)
-            logger.info("Batch %s contains %d B", digest.hex(), size)
+                if n >= 9 and serialized[off] == 0:
+                    (sample_id,) = struct.unpack_from(">Q", serialized, off + 1)
+                    logger.info(
+                        "Batch %s contains sample tx %d", digest_hex, sample_id
+                    )
+            logger.info("Batch %s contains %d B", digest_hex, size)
         if self.metrics is not None:
             self.metrics.created_batch_size.observe(size)
             self.metrics.batches_made.inc()

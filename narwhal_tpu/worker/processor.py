@@ -13,7 +13,7 @@ import asyncio
 from ..channels import Channel, Subscriber, Watch
 from ..messages import OthersBatchMsg, OurBatchMsg
 from ..stores import BatchStore
-from ..types import WorkerId, serialized_batch_digest
+from ..types import SealedBatch, WorkerId, serialized_batch_digest
 
 
 class Processor:
@@ -38,10 +38,16 @@ class Processor:
 
     async def run(self) -> None:
         while True:
-            serialized, own = await self.rx_batch.recv()
+            payload, own = await self.rx_batch.recv()
             if self.rx_reconfigure.peek().kind == "shutdown":
                 return
-            digest = serialized_batch_digest(serialized)
+            if isinstance(payload, SealedBatch):
+                # Own batch: the digest is cached on the sealed object.
+                digest, serialized = payload.digest, payload.serialized
+            else:
+                # Peer bytes are untrusted: hash the wire form ourselves.
+                serialized = payload
+                digest = serialized_batch_digest(serialized)
             self.store.write(digest, serialized)
             msg = (
                 OurBatchMsg(digest, self.worker_id)

@@ -11,13 +11,20 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..channels import Channel, Subscriber, Watch
+from ..channels import BoundedFuturesOrdered, Channel, Subscriber, Watch
 from ..config import Committee, WorkerCache
 from ..messages import WorkerBatchMsg
 from ..network import NetworkClient
-from ..types import Batch, PublicKey, WorkerId
+from ..types import PublicKey, SealedBatch, WorkerId
 
 logger = logging.getLogger("narwhal.worker")
+
+# Batches disseminating concurrently. Sequential dissemination caps
+# throughput at batch_size / quorum-RTT; pipelining hides the round-trip
+# while BoundedFuturesOrdered keeps the processor seeing batches in seal
+# order (the reference gets the same effect from cheap RTTs; here the
+# in-flight window is explicit).
+MAX_INFLIGHT_BATCHES = 64
 
 
 class QuorumWaiter:
@@ -45,45 +52,74 @@ class QuorumWaiter:
         return asyncio.ensure_future(self.run())
 
     async def run(self) -> None:
-        while True:
-            batch: Batch = await self.rx_message.recv()
-            note = self.rx_reconfigure.peek()
-            if note.kind == "shutdown":
-                return
-            if note.committee is not None and note.committee is not self.committee:
-                # Adopt the reconfigured committee before counting stake.
-                self.committee = note.committee
-            serialized = batch.to_bytes()
-            others = self.worker_cache.others_workers(self.name, self.worker_id)
-            msg = WorkerBatchMsg(serialized)
-            handles = [
-                (self.committee.stake(pk), self.network.send(info.worker_address, msg))
-                for pk, info in others
-            ]
+        pool = BoundedFuturesOrdered(MAX_INFLIGHT_BATCHES)
+        forwarder = asyncio.ensure_future(self._forward(pool))
+        try:
+            while True:
+                batch: SealedBatch = await self.rx_message.recv()
+                note = self.rx_reconfigure.peek()
+                if note.kind == "shutdown":
+                    return
+                if note.committee is not None and note.committee is not self.committee:
+                    # Adopt the reconfigured committee before counting stake.
+                    self.committee = note.committee
+                # Push blocks once MAX_INFLIGHT_BATCHES are disseminating:
+                # backpressure flows to the batch maker's channel.
+                await pool.push(self._disseminate(batch))
+        finally:
+            forwarder.cancel()
+            pool.cancel_all()
 
-            total = self.committee.stake(self.name)  # our own vote
-            threshold = self.committee.quorum_threshold()
-            pending = {
-                asyncio.ensure_future(self._wait(stake, h)) for stake, h in handles
-            }
+    async def _forward(self, pool: BoundedFuturesOrdered) -> None:
+        """Pop dissemination results in seal order and hand quorum-acked
+        batches to the processor."""
+        while True:
             try:
-                while total < threshold and pending:
-                    done, _ = await asyncio.wait(
-                        pending, return_when=asyncio.FIRST_COMPLETED
-                    )
-                    for t in done:
-                        total += t.result()
-                        pending.discard(t)
-            finally:
-                # Remaining reliable sends keep retrying in the background
-                # (the reference lets its CancelOnDrop handles continue until
-                # the waiter future set is dropped after quorum).
-                for t in pending:
-                    t.cancel()
-            if total >= threshold:
-                await self.tx_batch.send((serialized, True))
+                batch, ok = await pool.next()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # A dissemination task died unexpectedly (e.g. a peer vanished
+                # from a reconfigured committee). Dropping that one batch is
+                # the quorum-failure outcome; dying here would silently stall
+                # the whole pipeline once the pool fills.
+                logger.exception("batch dissemination task failed")
+                continue
+            if ok:
+                # The SealedBatch travels intact: its cached digest spares the
+                # processor a re-hash of our own payload bytes.
+                await self.tx_batch.send((batch, True))
             else:
                 logger.warning("batch dissemination failed to reach quorum")
+
+    async def _disseminate(self, batch: SealedBatch) -> tuple[SealedBatch, bool]:
+        serialized = batch.serialized
+        others = self.worker_cache.others_workers(self.name, self.worker_id)
+        msg = WorkerBatchMsg(serialized)
+        handles = [
+            (self.committee.stake(pk), self.network.send(info.worker_address, msg))
+            for pk, info in others
+        ]
+        total = self.committee.stake(self.name)  # our own vote
+        threshold = self.committee.quorum_threshold()
+        pending = {
+            asyncio.ensure_future(self._wait(stake, h)) for stake, h in handles
+        }
+        try:
+            while total < threshold and pending:
+                done, _ = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    total += t.result()
+                    pending.discard(t)
+        finally:
+            # Remaining reliable sends keep retrying in the background
+            # (the reference lets its CancelOnDrop handles continue until
+            # the waiter future set is dropped after quorum).
+            for t in pending:
+                t.cancel()
+        return batch, total >= threshold
 
     @staticmethod
     async def _wait(stake: int, handle) -> int:

@@ -38,7 +38,13 @@ from ..messages import (
 from ..metrics import Registry
 from ..network import NetworkClient, RpcServer
 from ..stores import BatchStore
-from ..types import Batch, PublicKey, ReconfigureNotification, WorkerId
+from ..types import (
+    Batch,
+    PublicKey,
+    ReconfigureNotification,
+    WorkerId,
+    validate_tx_frames,
+)
 from .batch_maker import BatchMaker
 from .metrics import WorkerMetrics
 from .primary_connector import PrimaryConnector
@@ -190,8 +196,10 @@ class Worker:
 
     async def _on_request_batch(self, msg: RequestBatchMsg, peer: str):
         raw = self.store.read(msg.digest)
-        txs = Batch.from_bytes(raw).transactions if raw is not None else ()
-        return RequestedBatchMsg(msg.digest, txs)
+        if raw is None:
+            return RequestedBatchMsg(msg.digest, b"", found=False)
+        # Serve the stored wire bytes as-is; decoding is the requester's.
+        return RequestedBatchMsg(msg.digest, raw)
 
     async def _on_delete_batches(self, msg: DeleteBatchesMsg, peer: str):
         self.store.delete_all(msg.digests)
@@ -206,13 +214,22 @@ class Worker:
 
     async def _on_tx(self, msg: SubmitTransactionMsg, peer: str):
         self.metrics.tx_received.inc()
-        await self.tx_batch_maker.send(msg.transaction)
+        tx = msg.transaction
+        frame = len(tx).to_bytes(4, "little") + tx
+        await self.tx_batch_maker.send((1, frame))
         return None
 
     async def _on_tx_stream(self, msg: SubmitTransactionStreamMsg, peer: str):
-        for tx in msg.transactions:
-            self.metrics.tx_received.inc()
-            await self.tx_batch_maker.send(tx)
+        # Bursts stay in wire form: validate the frame structure (the only
+        # per-tx work, two unpacks each, no copies) and forward the whole
+        # chunk as one channel item straight into batch sealing.
+        count = msg.count
+        if count == 0:
+            return None  # empty submission: no-op, never an empty batch
+        frames = msg.frames
+        validate_tx_frames(frames, count)
+        self.metrics.tx_received.inc(count)
+        await self.tx_batch_maker.send((count, frames))
         return None
 
     # -- lifecycle --------------------------------------------------------

@@ -8,7 +8,8 @@ from narwhal_tpu.fixtures import CommitteeFixture
 from narwhal_tpu.types import Batch, Certificate
 
 # Deterministic fixture: seeded keypairs => stable keys, digests, signatures
-# are deterministic for ed25519 (RFC 8032).
+# are deterministic for ed25519 (RFC 8032). Digests are SHA-256 of the
+# canonical encoding (see crypto.digest256).
 F = CommitteeFixture(size=4, seed=0)
 
 
@@ -18,14 +19,14 @@ def test_batch_format_snapshot():
         "02000000" "05000000" + b"alpha".hex() + "04000000" + b"beta".hex()
     )
     assert b.digest.hex() == (
-        "8a208d6b5ef9b60be4f1892f4473263b7269acede8a87f0392d7e5b405be211a"
+        "5e380ce3c499b6767ae9351088e94e34eaaae7161502ece47e8a05cc7aaf3112"
     )
 
 
 def test_header_format_snapshot():
     h = F.header(author=0, round=1)
     assert h.digest.hex() == (
-        "addfc7891231ba34c589408397e9eb24720e15a1b52a688b768e6b6b6bb5046e"
+        "bf3c6b646a0f4332d70ebf16eb86965f98b613f1a1a3a52ff8d3b94b64c531aa"
     )
     # author (32B raw) + round + epoch + empty payload map + 4 genesis parents
     wire = h.to_bytes()
@@ -38,7 +39,7 @@ def test_certificate_format_snapshot():
     gen = Certificate.genesis(F.committee)
     digests = sorted(c.digest.hex() for c in gen)
     assert digests[0] == (
-        "00a62328a6f7077216d6b07d87ae074973adbecb3360df41116d047cfe8c2393"
+        "44b0b7462bee58356162d1286f3fdf02426f4dda0f0d01d56e2dc0c6dad1207b"
     )
     cert = F.certificate(F.header(author=0, round=1))
     rt = Certificate.from_bytes(cert.to_bytes())

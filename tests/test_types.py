@@ -5,7 +5,7 @@ import pytest
 
 from narwhal_tpu.codec import CodecError, Reader, Writer
 from narwhal_tpu.config import Committee, Parameters, WorkerCache
-from narwhal_tpu.crypto import KeyPair, batch_verify, blake2b_256, verify
+from narwhal_tpu.crypto import KeyPair, batch_verify, digest256, verify
 from narwhal_tpu.fixtures import CommitteeFixture, make_optimal_certificates
 from narwhal_tpu.types import (
     Batch,
@@ -63,7 +63,7 @@ def test_header_sign_verify():
 
     # tampered payload => signature invalid
     tampered = Header(
-        h.author, h.round, h.epoch, {blake2b_256(b"x"): 0}, h.parents, h.signature
+        h.author, h.round, h.epoch, {digest256(b"x"): 0}, h.parents, h.signature
     )
     with pytest.raises(DagError):
         tampered.verify(f.committee, f.worker_cache)

@@ -26,15 +26,19 @@ def _watch():
     return Watch(ReconfigureNotification("boot"))
 
 
+def _chunk(*txs: bytes) -> tuple[int, bytes]:
+    """(count, frames) wire chunk, as the ingest handlers produce."""
+    return len(txs), b"".join(len(t).to_bytes(4, "little") + t for t in txs)
+
+
 def test_batch_maker_seals_on_size(run):
     async def scenario():
         rx, tx_out = Channel(100), Channel(10)
         bm = BatchMaker(100, 10.0, rx, tx_out, _watch())
         task = bm.spawn()
         for i in range(4):
-            await rx.send(bytes([i]) * 30)  # 120 B total > 100
+            await rx.send(_chunk(bytes([i]) * 30))  # 120 B total > 100
         batch = await asyncio.wait_for(tx_out.recv(), 2.0)
-        assert isinstance(batch, Batch)
         assert batch.size_bytes >= 100
         task.cancel()
 
@@ -46,7 +50,7 @@ def test_batch_maker_seals_on_timer(run):
         rx, tx_out = Channel(100), Channel(10)
         bm = BatchMaker(1_000_000, 0.05, rx, tx_out, _watch())
         task = bm.spawn()
-        await rx.send(b"lonely-tx")
+        await rx.send(_chunk(b"lonely-tx"))
         batch = await asyncio.wait_for(tx_out.recv(), 2.0)
         assert batch.transactions == (b"lonely-tx",)
         task.cancel()
