@@ -75,6 +75,7 @@ async def _run_node(args) -> None:
             storage,
             internal_consensus=not args.consensus_disabled,
             crypto_backend=getattr(args, "crypto_backend", "cpu"),
+            dag_backend=getattr(args, "dag_backend", "cpu"),
         )
         await node.spawn()
         registry = node.registry
@@ -132,6 +133,11 @@ def main(argv: list[str] | None = None) -> None:
         "--crypto-backend", choices=("cpu", "pool", "tpu"), default="cpu",
         help="signature verification: inline host (cpu), coalescing host "
         "pool, or the TPU batch kernel",
+    )
+    p.add_argument(
+        "--dag-backend", choices=("cpu", "tpu"), default="cpu",
+        help="consensus commit walk: host order_dag (cpu) or the on-device "
+        "adjacency-tensor kernels (tpu)",
     )
     w = rsub.add_parser("worker")
     w.add_argument("--id", type=int, required=True)

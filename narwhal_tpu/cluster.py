@@ -72,6 +72,7 @@ class Cluster:
         benchmark: bool = False,
         store_base: str | None = None,
         crypto_backend: str = "cpu",
+        dag_backend: str = "cpu",
     ):
         self.fixture = CommitteeFixture(size=size, workers=workers)
         self.parameters = parameters or replace(
@@ -81,6 +82,7 @@ class Cluster:
         self.benchmark = benchmark
         self.store_base = store_base
         self.crypto_backend = crypto_backend
+        self.dag_backend = dag_backend
         # Pre-assign real ports so no early broadcast targets a placeholder.
         committee = self.fixture.committee
         for pk, auth in committee.authorities.items():
@@ -119,6 +121,7 @@ class Cluster:
             storage,
             internal_consensus=self.internal_consensus,
             crypto_backend=self.crypto_backend,
+            dag_backend=self.dag_backend,
         )
         await details.primary.spawn()
         for wid in range(self.fixture.workers_per_authority):

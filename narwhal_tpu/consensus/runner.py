@@ -54,6 +54,10 @@ class Consensus:
             gc_depth,
             metrics,
         )
+        # Device-backed protocols mirror the recovered host DAG into their
+        # window tensors (TpuBullshark.recover); host engines need nothing.
+        if hasattr(protocol, "recover"):
+            protocol.recover(self.state)
         self._task: asyncio.Task | None = None
 
     def spawn(self) -> asyncio.Task:
@@ -92,9 +96,16 @@ class Consensus:
             cert_task.cancel()
 
     async def _process(self, certificate: Certificate) -> None:
-        sequence = self.protocol.process_certificate(
-            self.state, self.consensus_index, certificate
-        )
+        if hasattr(self.protocol, "process_certificate_async"):
+            # Device-backed protocols overlap their device->host readback
+            # with the rest of the node's event loop.
+            sequence = await self.protocol.process_certificate_async(
+                self.state, self.consensus_index, certificate
+            )
+        else:
+            sequence = self.protocol.process_certificate(
+                self.state, self.consensus_index, certificate
+            )
         if sequence:
             self.consensus_index = sequence[-1].consensus_index + 1
         for output in sequence:

@@ -79,6 +79,7 @@ class PrimaryNode:
         consensus_protocol: str = "bullshark",
         registry: Registry | None = None,
         crypto_backend: str = "cpu",  # cpu | pool | tpu
+        dag_backend: str = "cpu",  # cpu | tpu
     ):
         self.keypair = keypair
         self.name: PublicKey = keypair.public
@@ -130,10 +131,27 @@ class PrimaryNode:
         self.dag: Dag | None = None
         self.execution_state = execution_state or SimpleExecutionState(storage)
         if internal_consensus:
-            protocol_cls = {"bullshark": Bullshark, "tusk": Tusk}[consensus_protocol]
-            protocol = protocol_cls(
-                committee, storage.consensus_store, parameters.gc_depth
-            )
+            # --dag-backend tpu: the commit walk runs on device via the
+            # adjacency-tensor kernels (SURVEY §7.8c; the reference's
+            # consensus/src/utils.rs:11-101 hot loop, vectorized).
+            if dag_backend == "tpu":
+                if consensus_protocol != "bullshark":
+                    raise ValueError(
+                        "dag_backend='tpu' implements the bullshark commit "
+                        "rule (TpuBullshark); use consensus_protocol='bullshark'"
+                    )
+                from .tpu.dag_kernels import TpuBullshark
+
+                protocol = TpuBullshark(
+                    committee, storage.consensus_store, parameters.gc_depth
+                )
+            else:
+                protocol_cls = {"bullshark": Bullshark, "tusk": Tusk}[
+                    consensus_protocol
+                ]
+                protocol = protocol_cls(
+                    committee, storage.consensus_store, parameters.gc_depth
+                )
             self.consensus = Consensus(
                 committee,
                 protocol,

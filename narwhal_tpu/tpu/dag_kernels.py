@@ -77,6 +77,47 @@ def leader_support(parent, present, stakes, support_off, leader_idx):
     return jnp.sum(jnp.where(voters, stakes, 0))
 
 
+@jax.jit
+def chain_commit(parent, present, gc_depth, lc_rel, lcr_rel, offs, onehots):
+    """One fused dispatch per commit event: the full chain flatten — a
+    lax.scan over the chain's leaders (oldest first), each step computing
+    that leader's reach mask through the certificates still uncommitted *at
+    that point in the chain* and advancing the per-authority last-committed
+    vector exactly as the host's state.update does between order_dag calls.
+
+    parent [W,N,N] u8, present [W,N] u8; gc_depth i32;
+    lc_rel [N] i32 = last committed round per authority, relative to the
+    window base (may be negative); lcr_rel i32 = last committed round
+    (max over authorities), relative; offs [K] i32 / onehots [K,N] u8 =
+    chain leaders oldest-first, zero-padded (a zero onehot is a no-op slot).
+
+    Returns masks [K,W,N] bool: post-GC-filter commit sets per leader; the
+    host only gathers certificates and appends outputs from them.
+    """
+    W, N, _ = parent.shape
+    rows = jnp.arange(W, dtype=jnp.int32)
+
+    def per_leader(carry, inp):
+        lc, lcr = carry
+        off, onehot = inp
+        uncommitted = (present.astype(bool) & (rows[:, None] > lc[None, :])).astype(
+            jnp.uint8
+        )
+        mask = reach_mask(parent, uncommitted, off, onehot)  # [W, N] bool
+        # order_dag's GC filter (utils.rs:93-97): drop certificates whose
+        # round has fallen gc_depth behind the pre-flatten committed round.
+        keep = mask & (rows[:, None] + gc_depth >= lcr)
+        committed_rounds = jnp.max(
+            jnp.where(keep, rows[:, None], jnp.int32(-(2**30))), axis=0
+        )
+        lc = jnp.maximum(lc, committed_rounds)
+        lcr = jnp.maximum(lcr, jnp.max(committed_rounds))
+        return (lc, lcr), keep
+
+    _, masks = lax.scan(per_leader, (lc_rel, lcr_rel), (offs, onehots))
+    return masks
+
+
 class DagWindow:
     """Host-managed ring of the last W rounds as dense arrays, with the
     digest <-> (round, authority) maps the tensors can't hold. This is the
@@ -182,6 +223,16 @@ class TpuBullshark:
         self._leader_fn = leader_fn
         self.win = DagWindow(committee, window or (gc_depth + 14))
 
+    def recover(self, state: ConsensusState) -> None:
+        """Rebuild the device window from a recovered host state (the
+        consensus runner's ConsensusState.new_from_store) so a restarted node
+        resumes committing from the on-disk DAG. Insertion is round-ascending
+        because parent links resolve against already-placed digests."""
+        keep_floor = max(0, state.last_committed_round - self.gc_depth)
+        for round in sorted(state.dag):
+            for _, cert in state.dag[round].values():
+                self.win.insert(cert, keep_floor)
+
     # -- leader election --------------------------------------------------
     def _leader_index(self, round: Round, dag) -> int | None:
         if self._leader_fn is not None:
@@ -196,24 +247,30 @@ class TpuBullshark:
             return idx
         return None
 
-    # -- tensor helpers ---------------------------------------------------
-    def _uncommitted(self, state: ConsensusState) -> np.ndarray:
-        lc = np.zeros((self.win.N,), np.int64)
+    # -- host bookkeeping -------------------------------------------------
+    def _linked_np(self, round: Round, idx: int, prev_round: Round, prev_idx: int) -> bool:
+        """Host-side chain linkage between consecutive even-round leaders
+        (utils.rs:40-53 `linked`): a 2-round frontier propagation over the
+        numpy parent mirror — O(N^2) bookkeeping, not the hot walk."""
+        frontier = np.zeros((self.win.N,), bool)
+        frontier[idx] = True
+        for rr in range(round, prev_round, -1):
+            off = self.win._off(rr)
+            if not (0 <= off < self.win.W):
+                return False
+            links = self.win.parent[off]  # [N, N]: (rr, a) -> (rr-1, p)
+            frontier = (links[frontier].any(axis=0)) & self.win.present[
+                self.win._off(rr - 1)
+            ].astype(bool)
+            if not frontier.any():
+                return False
+        return bool(frontier[prev_idx])
+
+    def _lc_rel(self, state: ConsensusState) -> np.ndarray:
+        lc = np.zeros((self.win.N,), np.int32)
         for pk, r in state.last_committed.items():
             lc[self.committee.index_of(pk)] = r
-        rounds = self.win.round_base + np.arange(self.win.W)[:, None]
-        return (self.win.present.astype(bool) & (rounds > lc[None, :])).astype(np.uint8)
-
-    def _reach(self, state: ConsensusState, round: Round, idx: int) -> np.ndarray:
-        onehot = np.zeros((self.win.N,), np.uint8)
-        onehot[idx] = 1
-        mask = reach_mask(
-            jnp.asarray(self.win.parent),
-            jnp.asarray(self._uncommitted(state)),
-            jnp.int32(self.win._off(round)),
-            jnp.asarray(onehot),
-        )
-        return np.asarray(mask)
+        return lc - np.int32(self.win.round_base)
 
     # -- protocol ---------------------------------------------------------
     def process_certificate(
@@ -222,6 +279,41 @@ class TpuBullshark:
         consensus_index: SequenceNumber,
         certificate: Certificate,
     ) -> list[ConsensusOutput]:
+        dispatch = self._ingest_and_dispatch(state, certificate)
+        if dispatch is None:
+            return []
+        masks_dev, K = dispatch
+        # Device->host readback of the commit masks: ~flat round-trip latency
+        # on a tunneled chip, microseconds on a local one. The async variant
+        # overlaps this with the node's event loop.
+        masks = np.asarray(masks_dev)  # [Kpad, W, N] bool, post-GC commit sets
+        return self._materialize(state, consensus_index, masks, K)
+
+    async def process_certificate_async(
+        self,
+        state: ConsensusState,
+        consensus_index: SequenceNumber,
+        certificate: Certificate,
+    ) -> list[ConsensusOutput]:
+        """process_certificate with the device readback awaited off-thread so
+        the node's event loop (workers, proposer, RPC) keeps running during
+        the device->host round trip. Used by the Consensus runner; events
+        stay serialized because the runner awaits each certificate in order."""
+        import asyncio
+
+        dispatch = self._ingest_and_dispatch(state, certificate)
+        if dispatch is None:
+            return []
+        masks_dev, K = dispatch
+        loop = asyncio.get_running_loop()
+        masks = await loop.run_in_executor(None, np.asarray, masks_dev)
+        return self._materialize(state, consensus_index, masks, K)
+
+    def _ingest_and_dispatch(self, state: ConsensusState, certificate: Certificate):
+        """Shared pre-readback half of process_certificate: record the
+        certificate, evaluate the commit rule on the host mirror, and — when
+        this certificate commits a leader — dispatch the fused chain walk.
+        Returns (device masks, chain length) or None."""
         round = certificate.round
         state.add(certificate)  # host mirror for recovery parity
         keep_floor = max(0, state.last_committed_round - self.gc_depth)
@@ -229,55 +321,75 @@ class TpuBullshark:
             raise RuntimeError(
                 f"round {round} outside DAG window (base {self.win.round_base}, W {self.win.W})"
             )
-
         r = round - 1
-        if r % 2 != 0 or r < 2:
-            return []
-        if r <= state.last_committed_round:
-            return []
+        if r % 2 != 0 or r < 2 or r <= state.last_committed_round:
+            return None
         leader_idx = self._leader_index(r, state.dag)
         if leader_idx is None:
-            return []
+            return None
+        return self._dispatch_commit(state, round, r, leader_idx)
 
-        support = int(
-            leader_support(
-                jnp.asarray(self.win.parent),
-                jnp.asarray(self.win.present),
-                jnp.asarray(self.win.stakes),
-                jnp.int32(self.win._off(round)),
-                jnp.int32(leader_idx),
-            )
-        )
+    def _dispatch_commit(self, state, round, r, leader_idx):
+        """Quorum pre-check + chain detection on the host mirror (cheap
+        bookkeeping), then ONE fused device dispatch for every flatten walk
+        of the commit event. Returns (device masks, chain length) or None."""
+        # Support quorum pre-check (one column read): a device readback costs
+        # a full round trip, so dispatch only when this certificate commits.
+        off_r = self.win._off(round)
+        voters = self.win.parent[off_r, :, leader_idx].astype(bool) & self.win.present[
+            off_r
+        ].astype(bool)
+        support = int(self.win.stakes[voters].sum())
         if support < self.committee.validity_threshold():
-            return []
+            return None
 
-        # Chain of linked leaders, newest to oldest (order_leaders).
+        # Chain of linked leaders (order_leaders): consecutive-leader linkage
+        # spans only two rounds, so it is cheap host bookkeeping; the O(W*N^2)
+        # flatten walks run on device in ONE fused dispatch.
         chain: list[tuple[Round, int]] = [(r, leader_idx)]
         cur_round, cur_idx = r, leader_idx
-        cur_reach = self._reach(state, cur_round, cur_idx)
         for lr in range(r - 2, state.last_committed_round + 1, -2):
             prev_idx = self._leader_index(lr, state.dag)
             if prev_idx is None:
                 continue
-            off = self.win._off(lr)
-            if 0 <= off < self.win.W and cur_reach[off, prev_idx]:
+            if self._linked_np(cur_round, cur_idx, lr, prev_idx):
                 chain.append((lr, prev_idx))
                 cur_round, cur_idx = lr, prev_idx
-                cur_reach = self._reach(state, cur_round, cur_idx)
 
+        # Pad the chain to power-of-two bucket lengths so one compilation
+        # serves steady state (K=1) and catch-up bursts alike.
+        chain = list(reversed(chain))  # oldest first, scan order
+        K = len(chain)
+        Kpad = 1
+        while Kpad < K:
+            Kpad *= 2
+        offs = np.zeros((Kpad,), np.int32)
+        onehots = np.zeros((Kpad, self.win.N), np.uint8)
+        for i, (lr, lidx) in enumerate(chain):
+            offs[i] = self.win._off(lr)
+            onehots[i, lidx] = 1
+
+        masks_dev = chain_commit(
+            jnp.asarray(self.win.parent),
+            jnp.asarray(self.win.present),
+            jnp.int32(self.gc_depth),
+            jnp.asarray(self._lc_rel(state)),
+            jnp.int32(state.last_committed_round - self.win.round_base),
+            jnp.asarray(offs),
+            jnp.asarray(onehots),
+        )
+        return masks_dev, K
+
+    def _materialize(
+        self, state: ConsensusState, consensus_index: SequenceNumber, masks, K: int
+    ) -> list[ConsensusOutput]:
+        """Gather certificates from the per-leader commit masks, update the
+        host recovery state and persist, in canonical (round, origin) order."""
         sequence: list[ConsensusOutput] = []
-        for lr, lidx in reversed(chain):
-            mask = self._reach(state, lr, lidx)
-            # GC retain bound is evaluated at flatten time, before this
-            # leader's own updates advance last_committed_round (the host
-            # order_dag computes its filtered list up front).
-            lcr_at_flatten = state.last_committed_round
-            order = np.argwhere(mask)  # row-major: ascending (offset, authority)
+        for k in range(K):
+            order = np.argwhere(masks[k])  # ascending (offset, authority)
             for off, aidx in order:
-                cround = self.win.round_base + int(off)
-                if cround + self.gc_depth < lcr_at_flatten:
-                    continue
-                cert = self.win.cert_at(cround, int(aidx))
+                cert = self.win.cert_at(self.win.round_base + int(off), int(aidx))
                 if cert is None:
                     continue
                 state.update(cert, self.gc_depth)

@@ -107,6 +107,63 @@ def test_node_restart_recovers_from_store(run, tmp_path):
     run(scenario(), timeout=120.0)
 
 
+def test_cluster_with_tpu_dag_backend(run, tmp_path):
+    """--dag-backend tpu: production consensus runs through TpuBullshark's
+    adjacency-tensor kernels. All nodes execute client transactions in an
+    identical order, and a restarted node rebuilds its device DAG window
+    from the store (TpuBullshark.recover) and resumes committing."""
+
+    async def scenario():
+        cluster = Cluster(
+            size=4, workers=1, store_base=str(tmp_path), dag_backend="tpu"
+        )
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            from narwhal_tpu.tpu.dag_kernels import TpuBullshark
+
+            assert isinstance(
+                cluster.authorities[0].primary.consensus.protocol, TpuBullshark
+            )
+            target = cluster.authorities[0].worker_transactions_address(0)
+            txs = tuple(bytes([7]) * 8 + bytes([i]) for i in range(32))
+            await client.request(target, SubmitTransactionStreamMsg(txs))
+
+            async def executed(details, count):
+                out = []
+                while len(out) < count:
+                    _, tx = await asyncio.wait_for(
+                        details.primary.tx_execution_output.recv(), 30.0
+                    )
+                    out.append(tx)
+                return out
+
+            results = await asyncio.gather(
+                *(executed(a, 32) for a in cluster.authorities)
+            )
+            assert all(len(r) == 32 for r in results)
+            assert results[0] == results[1] == results[2] == results[3]
+            assert set(results[0]) == set(txs)
+
+            # Restart: the fresh TpuBullshark must recover its window from
+            # the recovered ConsensusState and keep committing.
+            await cluster.restart_node(0)
+            before = max(
+                a.metric("consensus_last_committed_round")
+                for a in cluster.authorities
+                if a.primary is not None
+            )
+            rounds = await cluster.assert_progress(
+                commit_threshold=int(before) + 2, timeout=30.0
+            )
+            assert rounds[cluster.authorities[0].name] >= int(before) + 2
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=150.0)
+
+
 def test_cluster_with_verification_pool(run):
     """crypto_backend="pool": the async pre-verification stage (coalesced
     batch verification off the Core's loop) must preserve liveness and
