@@ -62,18 +62,29 @@ class LocalBench:
         authorities = {}
         workers = {}
         for i, kp in enumerate(keypairs):
+            network_kp = KeyPair.generate()
+            worker_kps = {wid: KeyPair.generate() for wid in range(self.bench.workers)}
             with open(f"{self.base}/key-{i}.json", "w") as f:
                 json.dump(
-                    {"name": kp.public.hex(), "seed": kp.private_bytes().hex()}, f
+                    {
+                        "name": kp.public.hex(),
+                        "seed": kp.private_bytes().hex(),
+                        "network_seed": network_kp.private_bytes().hex(),
+                        "worker_network_seeds": {
+                            str(wid): wkp.private_bytes().hex()
+                            for wid, wkp in worker_kps.items()
+                        },
+                    },
+                    f,
                 )
             authorities[kp.public] = Authority(
                 stake=1,
                 primary_address=f"127.0.0.1:{get_available_port()}",
-                network_key=kp.public,
+                network_key=network_kp.public,
             )
             workers[kp.public] = {
                 wid: WorkerInfo(
-                    name=kp.public,
+                    name=worker_kps[wid].public,
                     transactions=f"127.0.0.1:{get_available_port()}",
                     worker_address=f"127.0.0.1:{get_available_port()}",
                 )

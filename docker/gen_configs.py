@@ -23,16 +23,26 @@ def main() -> None:
     authorities = {}
     workers = {}
     for i, kp in enumerate(keypairs):
+        network_kp = KeyPair.generate()
+        worker_kp = KeyPair.generate()
         with open(f"{OUT}/key-{i}.json", "w") as f:
-            json.dump({"name": kp.public.hex(), "seed": kp.private_bytes().hex()}, f)
+            json.dump(
+                {
+                    "name": kp.public.hex(),
+                    "seed": kp.private_bytes().hex(),
+                    "network_seed": network_kp.private_bytes().hex(),
+                    "worker_network_seeds": {"0": worker_kp.private_bytes().hex()},
+                },
+                f,
+            )
         authorities[kp.public] = Authority(
             stake=1,
             primary_address=f"primary-{i}:4000",
-            network_key=kp.public,
+            network_key=network_kp.public,
         )
         workers[kp.public] = {
             0: WorkerInfo(
-                name=kp.public,
+                name=worker_kp.public,
                 transactions=f"worker-{i}:4001",
                 worker_address=f"worker-{i}:4002",
             )

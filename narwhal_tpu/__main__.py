@@ -41,24 +41,40 @@ def _setup_logging(verbosity: int) -> None:
     )
 
 
-def _load_keypair(path: str) -> KeyPair:
+def _load_keys(path: str) -> dict:
     with open(path) as f:
-        data = json.load(f)
-    return KeyPair.from_seed(bytes.fromhex(data["seed"]))
+        return json.load(f)
 
 
 def cmd_generate_keys(args) -> None:
+    """Emit the authority's protocol keypair plus its transport identities:
+    the primary network key and one network key per worker lane (the
+    reference's generate_keys + generate_network_keys,
+    node/src/main.rs:40-76)."""
     import secrets
 
     seed = secrets.token_bytes(32)
+    network_seed = secrets.token_bytes(32)
+    worker_seeds = {str(w): secrets.token_bytes(32) for w in range(args.workers)}
     kp = KeyPair.from_seed(seed)
+    doc = {
+        "name": kp.public.hex(),
+        "seed": seed.hex(),
+        "network_seed": network_seed.hex(),
+        "network_key": KeyPair.from_seed(network_seed).public.hex(),
+        "worker_network_seeds": {w: s.hex() for w, s in worker_seeds.items()},
+        "worker_network_keys": {
+            w: KeyPair.from_seed(s).public.hex() for w, s in worker_seeds.items()
+        },
+    }
     with open(args.filename, "w") as f:
-        json.dump({"name": kp.public.hex(), "seed": seed.hex()}, f, indent=2)
+        json.dump(doc, f, indent=2)
     print(kp.public.hex())
 
 
 async def _run_node(args) -> None:
-    keypair = _load_keypair(args.keys)
+    keys = _load_keys(args.keys)
+    keypair = KeyPair.from_seed(bytes.fromhex(keys["seed"]))
     committee = Committee.import_(args.committee)
     worker_cache = WorkerCache.import_(args.workers)
     parameters = (
@@ -66,6 +82,17 @@ async def _run_node(args) -> None:
     )
 
     if args.role == "primary":
+        if "network_seed" not in keys and not args.insecure:
+            raise SystemExit(
+                "key file has no 'network_seed': transport authentication would "
+                "be silently disabled. Regenerate with `generate_keys` or pass "
+                "--insecure to run an open mesh deliberately."
+            )
+        network_keypair = (
+            KeyPair.from_seed(bytes.fromhex(keys["network_seed"]))
+            if "network_seed" in keys
+            else None
+        )
         storage = NodeStorage(f"{args.store}-primary" if args.store else None)
         node = PrimaryNode(
             keypair,
@@ -76,10 +103,21 @@ async def _run_node(args) -> None:
             internal_consensus=not args.consensus_disabled,
             crypto_backend=getattr(args, "crypto_backend", "cpu"),
             dag_backend=getattr(args, "dag_backend", "cpu"),
+            network_keypair=network_keypair,
         )
         await node.spawn()
         registry = node.registry
     else:
+        worker_seed = keys.get("worker_network_seeds", {}).get(str(args.id))
+        if worker_seed is None and not args.insecure:
+            raise SystemExit(
+                f"key file has no worker_network_seeds entry for worker {args.id}: "
+                "transport authentication would be silently disabled. Regenerate "
+                "with `generate_keys --workers N` or pass --insecure."
+            )
+        network_keypair = (
+            KeyPair.from_seed(bytes.fromhex(worker_seed)) if worker_seed else None
+        )
         storage = NodeStorage(
             f"{args.store}-worker-{args.id}" if args.store else None
         )
@@ -91,6 +129,7 @@ async def _run_node(args) -> None:
             parameters,
             storage,
             benchmark=True,
+            network_keypair=network_keypair,
         )
         await node.spawn()
         registry = node.registry
@@ -116,9 +155,18 @@ def main(argv: list[str] | None = None) -> None:
 
     g = sub.add_parser("generate_keys")
     g.add_argument("--filename", required=True)
+    g.add_argument(
+        "--workers", type=int, default=4,
+        help="worker lanes to generate transport identities for",
+    )
 
     r = sub.add_parser("run")
     r.add_argument("--keys", required=True)
+    r.add_argument(
+        "--insecure", action="store_true",
+        help="run without transport authentication when the key file lacks "
+        "network seeds (testing only)",
+    )
     r.add_argument("--committee", required=True)
     r.add_argument("--workers", required=True)
     r.add_argument("--parameters", default=None)

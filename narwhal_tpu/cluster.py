@@ -122,6 +122,7 @@ class Cluster:
             internal_consensus=self.internal_consensus,
             crypto_backend=self.crypto_backend,
             dag_backend=self.dag_backend,
+            network_keypair=fixture_auth.network_keypair,
         )
         await details.primary.spawn()
         for wid in range(self.fixture.workers_per_authority):
@@ -133,6 +134,7 @@ class Cluster:
                 self.parameters,
                 self._store(index, f"worker-{wid}"),
                 benchmark=self.benchmark,
+                network_keypair=fixture_auth.worker_keypairs[wid],
             )
             await wn.spawn()
             details.workers[wid] = wn

@@ -328,9 +328,20 @@ class Shared:
         self.value = new
 
 
+_HANDED_OUT: set[int] = set()
+
+
 def get_available_port(host: str = "127.0.0.1") -> int:
-    """(/root/reference/config/src/utils.rs:9-33)."""
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((host, 0))
-        return s.getsockname()[1]
+    """(/root/reference/config/src/utils.rs:9-33). Ports are pre-assigned
+    before servers bind them, so remember what we handed out within this
+    process and never hand the same port twice — the OS allocator can cycle
+    back to a port whose server has not bound yet."""
+    for _ in range(64):
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, 0))
+            port = s.getsockname()[1]
+        if port not in _HANDED_OUT:
+            _HANDED_OUT.add(port)
+            return port
+    raise OSError("no available port after 64 attempts")

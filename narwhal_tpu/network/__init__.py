@@ -1,3 +1,4 @@
+from .auth import AuthError, Credentials, Peer, committee_resolver
 from .rpc import (
     NetworkClient,
     PeerClient,
@@ -7,9 +8,13 @@ from .rpc import (
 )
 
 __all__ = [
+    "AuthError",
+    "Credentials",
     "NetworkClient",
+    "Peer",
     "PeerClient",
     "RetryConfig",
     "RpcError",
     "RpcServer",
+    "committee_resolver",
 ]

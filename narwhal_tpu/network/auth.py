@@ -1,0 +1,242 @@
+"""Mutual transport authentication for the validator mesh.
+
+Reference: the anemo network gives every peer an ed25519 identity — a
+`PeerId` derived from its network key — and mutually-authenticated TLS
+(/root/reference/network/src/p2p.rs:26-158; worker keys registered as known
+peers at /root/reference/worker/src/worker.rs:137-146). Connections from
+unknown identities never reach the validator-internal RPC handlers, and all
+post-handshake traffic is protected by the TLS channel.
+
+Here the same authenticity guarantee comes from a signed authenticated key
+exchange plus per-frame MACs:
+
+1. The server opens with a nonce, its network key and an ephemeral X25519
+   public key; the client answers with its network key, a nonce, its own
+   ephemeral key and an ed25519 signature over the whole transcript; the
+   server signs the transcript back. Both signatures bind the ephemeral
+   keys to the committee identities (config.Authority.network_key for
+   primaries, WorkerInfo.name for workers), so a relay cannot substitute
+   its own ephemerals.
+2. X25519(eph, eph') gives a shared secret only the two endpoints know;
+   per-direction MAC keys are derived from it and the transcript, and every
+   subsequent frame carries a keyed-BLAKE2b tag over (direction, sequence
+   number, frame header, body). An on-path attacker can therefore neither
+   inject nor replay nor reorder frames after the handshake.
+
+Routes attach `allow` predicates on the verified identity (control-plane
+frames accept only the node's own primary, etc. — the authorization matrix
+lives in worker.py / primary.py). Public edges (tx ingest, the consensus
+API) stay unauthenticated, exactly like the reference's tonic gRPC plane.
+
+MAC only (no encryption): BFT safety needs authenticity, not secrecy —
+every protocol message is broadcast to the committee anyway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import hmac as hmac_mod
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives import serialization as _ser
+
+from ..crypto import KeyPair, verify
+from ..types import PublicKey
+
+HS_TIMEOUT = 5.0
+MAC_LEN = 16
+_CLIENT_DOMAIN = b"narwhal-hs-client-v2"
+_SERVER_DOMAIN = b"narwhal-hs-server-v2"
+
+# Handshake frame kinds (share the RPC frame header; rid/tag are zero).
+KIND_HELLO = 3  # server -> client: nonce_s(32) | server_pub(32) | server_eph(32)
+KIND_AUTH = 4  # client -> client_pub(32) | nonce_c(32) | client_eph(32) | sig(64)
+KIND_AUTH_OK = 5  # server -> client: sig(64)
+
+
+class AuthError(Exception):
+    pass
+
+
+@dataclass
+class Peer:
+    """Identity of the remote end of a connection, as seen by handlers:
+    `key` is the handshake-verified network public key, or None on
+    unauthenticated (public-plane) servers."""
+
+    addr: str
+    key: Optional[PublicKey] = None
+
+    def __str__(self) -> str:  # handlers log the peer; keep it readable
+        return self.addr
+
+
+class Session:
+    """Per-connection frame authentication state: independent keyed-BLAKE2b
+    MAC keys and sequence counters for each direction."""
+
+    def __init__(self, send_key: bytes, recv_key: bytes):
+        self._send_key = send_key
+        self._recv_key = recv_key
+        self._send_seq = 0
+        self._recv_seq = 0
+
+    @staticmethod
+    def _tag(key: bytes, seq: int, kind: int, rid: int, tag: int, body: bytes) -> bytes:
+        h = hashlib.blake2b(digest_size=MAC_LEN, key=key)
+        h.update(seq.to_bytes(8, "little"))
+        h.update(bytes([kind]))
+        h.update(rid.to_bytes(8, "little"))
+        h.update(tag.to_bytes(2, "little"))
+        h.update(body)
+        return h.digest()
+
+    def seal(self, kind: int, rid: int, tag: int, body: bytes) -> bytes:
+        mac = self._tag(self._send_key, self._send_seq, kind, rid, tag, body)
+        self._send_seq += 1
+        return mac
+
+    def open(self, kind: int, rid: int, tag: int, body: bytes, mac: bytes) -> None:
+        want = self._tag(self._recv_key, self._recv_seq, kind, rid, tag, body)
+        if not hmac_mod.compare_digest(want, mac):
+            raise AuthError("frame MAC mismatch")
+        self._recv_seq += 1
+
+
+class Credentials:
+    """A node's network identity plus its view of who should answer at each
+    mesh address. `resolve(addr)` returns the expected network key for a
+    mesh address (primary_address / worker_address) or None for public
+    endpoints — None skips the handshake entirely."""
+
+    def __init__(
+        self,
+        keypair: KeyPair,
+        resolve: Callable[[str], Optional[PublicKey]],
+    ):
+        self.keypair = keypair
+        self.resolve = resolve
+
+
+def committee_resolver(get_committee, get_worker_cache) -> Callable[[str], Optional[PublicKey]]:
+    """Resolve mesh addresses against the *current* committee/worker-cache
+    (callables, so epoch changes are picked up live): primary addresses map
+    to Authority.network_key, worker mesh addresses to WorkerInfo.name.
+    Transaction-ingest addresses are deliberately absent (public plane)."""
+
+    def resolve(addr: str) -> Optional[PublicKey]:
+        committee = get_committee()
+        for auth in committee.authorities.values():
+            if auth.primary_address == addr:
+                return auth.network_key
+        worker_cache = get_worker_cache()
+        if worker_cache is not None:
+            for workers in worker_cache.workers.values():
+                for info in workers.values():
+                    if info.worker_address == addr:
+                        return info.name
+        return None
+
+    return resolve
+
+
+def _raw_x25519_pub(priv: X25519PrivateKey) -> bytes:
+    return priv.public_key().public_bytes(_ser.Encoding.Raw, _ser.PublicFormat.Raw)
+
+
+def _transcript(
+    nonce_s: bytes, nonce_c: bytes, server_pub: bytes, client_pub: bytes,
+    server_eph: bytes, client_eph: bytes,
+) -> bytes:
+    return hashlib.blake2b(
+        nonce_s + nonce_c + server_pub + client_pub + server_eph + client_eph,
+        digest_size=32,
+    ).digest()
+
+
+def _derive_keys(shared: bytes, transcript: bytes) -> tuple[bytes, bytes]:
+    """(client->server key, server->client key)."""
+    c2s = hashlib.blake2b(shared + transcript + b"c2s", digest_size=32).digest()
+    s2c = hashlib.blake2b(shared + transcript + b"s2c", digest_size=32).digest()
+    return c2s, s2c
+
+
+async def client_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    credentials: Credentials,
+    expected_key: PublicKey,
+    read_frame,
+    write_frame,
+) -> Session:
+    """Client half: await HELLO, check the server presents the key the
+    committee lists for this address, run the signed X25519 exchange and
+    return the frame-MAC session. Raises AuthError on any mismatch."""
+    kind, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
+    if kind != KIND_HELLO or len(body) != 96:
+        raise AuthError("peer did not open with a handshake HELLO")
+    nonce_s, server_pub, server_eph = body[:32], body[32:64], body[64:]
+    if server_pub != expected_key:
+        raise AuthError("server identity does not match committee network key")
+    client_pub = credentials.keypair.public
+    nonce_c = os.urandom(32)
+    eph_priv = X25519PrivateKey.generate()
+    client_eph = _raw_x25519_pub(eph_priv)
+    transcript = _transcript(
+        nonce_s, nonce_c, server_pub, client_pub, server_eph, client_eph
+    )
+    sig = credentials.keypair.sign(_CLIENT_DOMAIN + transcript)
+    write_frame(writer, KIND_AUTH, 0, 0, client_pub + nonce_c + client_eph + sig)
+    await writer.drain()
+    kind, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
+    if kind != KIND_AUTH_OK or len(body) != 64:
+        raise AuthError("server rejected handshake")
+    if not verify(server_pub, _SERVER_DOMAIN + transcript, body):
+        raise AuthError("server handshake signature invalid")
+    shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(server_eph))
+    c2s, s2c = _derive_keys(shared, transcript)
+    return Session(send_key=c2s, recv_key=s2c)
+
+
+async def server_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    keypair: KeyPair,
+    read_frame,
+    write_frame,
+) -> tuple[PublicKey, Session]:
+    """Server half: send HELLO with our ephemeral, verify the client's
+    signed transcript, sign it back. Returns the client's verified network
+    key and the frame-MAC session."""
+    nonce_s = os.urandom(32)
+    server_pub = keypair.public
+    eph_priv = X25519PrivateKey.generate()
+    server_eph = _raw_x25519_pub(eph_priv)
+    write_frame(writer, KIND_HELLO, 0, 0, nonce_s + server_pub + server_eph)
+    await writer.drain()
+    kind, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
+    if kind != KIND_AUTH or len(body) != 160:
+        raise AuthError("client did not authenticate")
+    client_pub, nonce_c, client_eph, sig = (
+        body[:32],
+        body[32:64],
+        body[64:96],
+        body[96:],
+    )
+    transcript = _transcript(
+        nonce_s, nonce_c, server_pub, client_pub, server_eph, client_eph
+    )
+    if not verify(client_pub, _CLIENT_DOMAIN + transcript, sig):
+        raise AuthError("client handshake signature invalid")
+    write_frame(writer, KIND_AUTH_OK, 0, 0, keypair.sign(_SERVER_DOMAIN + transcript))
+    await writer.drain()
+    shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(client_eph))
+    c2s, s2c = _derive_keys(shared, transcript)
+    return client_pub, Session(send_key=s2c, recv_key=c2s)

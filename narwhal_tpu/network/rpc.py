@@ -30,6 +30,16 @@ from typing import Awaitable, Callable, Iterable
 
 from ..channels import CancelOnDrop
 from ..messages import Ack, decode_message, encode_message
+from .auth import (
+    KIND_HELLO,
+    MAC_LEN,
+    AuthError,
+    Credentials,
+    Peer,
+    Session,
+    client_handshake,
+    server_handshake,
+)
 
 logger = logging.getLogger("narwhal.network")
 
@@ -80,34 +90,65 @@ def _pack(kind: int, rid: int, tag: int, body: bytes) -> bytes:
     return _FRAME_HDR.pack(len(body), kind, rid, tag) + body
 
 
-def _write_frame(writer: asyncio.StreamWriter, kind: int, rid: int, tag: int, body: bytes) -> None:
+def _write_frame(
+    writer: asyncio.StreamWriter,
+    kind: int,
+    rid: int,
+    tag: int,
+    body: bytes,
+    session: Session | None = None,
+) -> None:
     # Two writes instead of one concatenated buffer: batch frames are large
     # (hundreds of KB) and the header+body copy showed up at high rates.
-    writer.write(_FRAME_HDR.pack(len(body), kind, rid, tag))
-    if body:
-        writer.write(body)
+    # On authenticated connections every frame carries a keyed MAC over
+    # (direction, sequence, header, body); seal+write happen without an
+    # await in between so the MAC sequence matches the wire order.
+    if session is not None:
+        mac = session.seal(kind, rid, tag, body)
+        writer.write(_FRAME_HDR.pack(len(body) + MAC_LEN, kind, rid, tag))
+        if body:
+            writer.write(body)
+        writer.write(mac)
+    else:
+        writer.write(_FRAME_HDR.pack(len(body), kind, rid, tag))
+        if body:
+            writer.write(body)
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, int, int, bytes]:
+async def _read_frame(
+    reader: asyncio.StreamReader, session: Session | None = None
+) -> tuple[int, int, int, bytes]:
     hdr = await reader.readexactly(_FRAME_HDR.size)
     length, kind, rid, tag = _FRAME_HDR.unpack(hdr)
     if length > MAX_FRAME:
         raise RpcError(f"frame of {length} bytes exceeds cap")
     body = await reader.readexactly(length) if length else b""
+    if session is not None:
+        if length < MAC_LEN:
+            raise RpcError("unauthenticated frame on authenticated connection")
+        body, mac = body[:-MAC_LEN], body[-MAC_LEN:]
+        session.open(kind, rid, tag, body, mac)  # raises AuthError on forgery
     return kind, rid, tag, body
 
 
 class PeerClient:
     """Persistent connection to one peer address with request/response
-    correlation and lazy reconnect."""
+    correlation and lazy reconnect. With credentials + an expected key the
+    connection is mutually authenticated before any request flows."""
 
-    def __init__(self, address: str):
+    def __init__(
+        self,
+        address: str,
+        credentials: Credentials | None = None,
+    ):
         self.address = address
+        self._credentials = credentials
         self._writer: asyncio.StreamWriter | None = None
         self._reader_task: asyncio.Task | None = None
         self._pending: dict[int, asyncio.Future] = {}
         self._rid = itertools.count(1)
         self._lock = asyncio.Lock()
+        self._session: Session | None = None
 
     async def _connect(self) -> None:
         async with self._lock:
@@ -115,13 +156,53 @@ class PeerClient:
                 return
             host, port = self.address.rsplit(":", 1)
             reader, writer = await asyncio.open_connection(host, int(port), limit=MAX_FRAME + 1024)
+            # Resolve the expected identity at connect time so reconnects
+            # after an epoch change see the current committee's keys.
+            expected_key = (
+                self._credentials.resolve(self.address)
+                if self._credentials is not None
+                else None
+            )
+            session = None
+            if self._credentials is not None and expected_key is not None:
+                try:
+                    session = await client_handshake(
+                        reader,
+                        writer,
+                        self._credentials,
+                        expected_key,
+                        _read_frame,
+                        _write_frame,
+                    )
+                except (AuthError, asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+                    writer.close()
+                    raise RpcError(f"handshake with {self.address} failed: {e}") from e
+            self._session = session
             self._writer = writer
-            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+            self._reader_task = asyncio.ensure_future(self._read_loop(reader, session))
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    async def _read_loop(
+        self, reader: asyncio.StreamReader, session: Session | None
+    ) -> None:
         try:
             while True:
-                kind, rid, tag, body = await _read_frame(reader)
+                kind, rid, tag, body = await _read_frame(reader, session)
+                if kind == KIND_HELLO and session is None:
+                    # The server demands a handshake we are not configured
+                    # for: fail every pending request immediately instead of
+                    # letting them time out one by one.
+                    logger.warning(
+                        "%s requires an authenticated handshake but this "
+                        "client has no credentials for it",
+                        self.address,
+                    )
+                    self._teardown(
+                        RpcError(
+                            f"{self.address} requires an authenticated "
+                            "handshake (no credentials resolve this address)"
+                        )
+                    )
+                    return
                 fut = self._pending.pop(rid, None)
                 if fut is None or fut.done():
                     continue
@@ -132,7 +213,7 @@ class PeerClient:
                         fut.set_exception(RpcError(str(e)))
                 elif kind == KIND_ERR:
                     fut.set_exception(RpcError(body.decode(errors="replace")))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError):
+        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError):
             pass
         finally:
             self._teardown(RpcError(f"connection to {self.address} lost"))
@@ -145,6 +226,7 @@ class PeerClient:
                 pass
         self._writer = None
         self._reader_task = None
+        self._session = None
         pending, self._pending = self._pending, {}
         for fut in pending.values():
             if not fut.done():
@@ -160,7 +242,7 @@ class PeerClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         try:
-            _write_frame(self._writer, KIND_REQ, rid, tag, body)
+            _write_frame(self._writer, KIND_REQ, rid, tag, body, self._session)
             await self._writer.drain()
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError) as e:
@@ -175,29 +257,49 @@ class PeerClient:
         self._teardown(RpcError("client closed"))
 
 
-Handler = Callable[[object, str], Awaitable[object | None]]
+Handler = Callable[[object, Peer], Awaitable[object | None]]
 
 
 class RpcServer:
     """Listens for peers and dispatches requests to handlers by message tag.
 
-    Handlers receive (message, peer_addr) and return a response message or
+    Handlers receive (message, Peer) and return a response message or
     None (=> Ack). Handler exceptions become ERR frames, like anemo's status
-    responses. Concurrency is bounded per connection."""
+    responses. Concurrency is bounded per connection.
 
-    def __init__(self, max_concurrency: int = MAX_TASK_CONCURRENCY):
-        self._handlers: dict[int, Handler] = {}
+    With `auth_keypair` set the server requires the mutual handshake on
+    every connection (the anemo PeerId model): unauthenticated sockets never
+    reach a handler, and routes may further restrict the verified identity
+    with an `allow(peer)` predicate — the reference rejects unknown peers at
+    the network layer (network/src/p2p.rs:26-158)."""
+
+    def __init__(
+        self,
+        max_concurrency: int = MAX_TASK_CONCURRENCY,
+        auth_keypair=None,
+    ):
+        self._handlers: dict[int, tuple[Handler, Callable[[Peer], bool] | None]] = {}
         self._server: asyncio.AbstractServer | None = None
         self._max_concurrency = max_concurrency
         self._writers: set[asyncio.StreamWriter] = set()
+        self._auth_keypair = auth_keypair
 
-    def route(self, msg_cls, handler: Handler) -> None:
-        self._handlers[msg_cls.TAG] = handler
+    def route(self, msg_cls, handler: Handler, allow=None) -> None:
+        self._handlers[msg_cls.TAG] = (handler, allow)
 
     async def start(self, host: str, port: int) -> int:
-        self._server = await asyncio.start_server(
-            self._on_connection, host, port, limit=MAX_FRAME + 1024
-        )
+        # A pre-assigned port can transiently collide (TIME_WAIT, an
+        # ephemeral outbound connection): retry briefly before giving up.
+        for attempt in range(5):
+            try:
+                self._server = await asyncio.start_server(
+                    self._on_connection, host, port, limit=MAX_FRAME + 1024
+                )
+                break
+            except OSError:
+                if attempt == 4:
+                    raise
+                await asyncio.sleep(0.2 * (attempt + 1))
         return self._server.sockets[0].getsockname()[1]
 
     @property
@@ -207,23 +309,33 @@ class RpcServer:
     async def _on_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        peer = writer.get_extra_info("peername")
-        peer_addr = f"{peer[0]}:{peer[1]}" if peer else "?"
+        peername = writer.get_extra_info("peername")
+        peer_addr = f"{peername[0]}:{peername[1]}" if peername else "?"
+        peer = Peer(peer_addr)
         self._writers.add(writer)
         sem = asyncio.Semaphore(self._max_concurrency)
         tasks: set[asyncio.Task] = set()
+        session: Session | None = None
         try:
+            if self._auth_keypair is not None:
+                try:
+                    peer.key, session = await server_handshake(
+                        reader, writer, self._auth_keypair, _read_frame, _write_frame
+                    )
+                except (AuthError, asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+                    logger.debug("Rejected unauthenticated peer %s: %s", peer_addr, e)
+                    return
             while True:
-                kind, rid, tag, body = await _read_frame(reader)
+                kind, rid, tag, body = await _read_frame(reader, session)
                 if kind != KIND_REQ:
                     continue
                 await sem.acquire()
                 t = asyncio.ensure_future(
-                    self._dispatch(writer, rid, tag, body, peer_addr)
+                    self._dispatch(writer, rid, tag, body, peer, session)
                 )
                 tasks.add(t)
                 t.add_done_callback(lambda t_: (tasks.discard(t_), sem.release()))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError):
+        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError):
             pass
         finally:
             self._writers.discard(writer)
@@ -235,12 +347,21 @@ class RpcServer:
                 pass
 
     async def _dispatch(
-        self, writer: asyncio.StreamWriter, rid: int, tag: int, body: bytes, peer: str
+        self,
+        writer: asyncio.StreamWriter,
+        rid: int,
+        tag: int,
+        body: bytes,
+        peer: Peer,
+        session: Session | None = None,
     ) -> None:
         try:
-            handler = self._handlers.get(tag)
-            if handler is None:
+            entry = self._handlers.get(tag)
+            if entry is None:
                 raise RpcError(f"no handler for tag {tag}")
+            handler, allow = entry
+            if allow is not None and not allow(peer):
+                raise RpcError(f"unauthorized peer for tag {tag}")
             msg = decode_message(tag, body)
             resp = await handler(msg, peer)
             if resp is None:
@@ -252,7 +373,7 @@ class RpcServer:
         except Exception as e:
             out = (KIND_ERR, rid, 0, str(e).encode())
         try:
-            _write_frame(writer, *out)
+            _write_frame(writer, *out, session)
             await writer.drain()
         except (ConnectionError, OSError):
             pass
@@ -273,17 +394,25 @@ class RpcServer:
 
 class NetworkClient:
     """The P2pNetwork facade (/root/reference/network/src/p2p.rs:26-158):
-    cached per-peer clients + the three send policies."""
+    cached per-peer clients + the three send policies. With credentials,
+    every connection to an address the committee/worker-cache knows is
+    mutually authenticated; unknown addresses (public endpoints) connect
+    plain."""
 
-    def __init__(self, retry: RetryConfig | None = None):
+    def __init__(
+        self,
+        retry: RetryConfig | None = None,
+        credentials: Credentials | None = None,
+    ):
         self._peers: dict[str, PeerClient] = {}
         self._retry = retry or RetryConfig(max_elapsed=None)
         self._send_tasks: set[asyncio.Task] = set()
+        self._credentials = credentials
 
     def peer(self, address: str) -> PeerClient:
         client = self._peers.get(address)
         if client is None:
-            client = PeerClient(address)
+            client = PeerClient(address, self._credentials)
             self._peers[address] = client
         return client
 

@@ -80,6 +80,7 @@ class PrimaryNode:
         registry: Registry | None = None,
         crypto_backend: str = "cpu",  # cpu | pool | tpu
         dag_backend: str = "cpu",  # cpu | tpu
+        network_keypair: KeyPair | None = None,
     ):
         self.keypair = keypair
         self.name: PublicKey = keypair.public
@@ -124,6 +125,7 @@ class PrimaryNode:
             ),
             registry=self.registry,
             crypto_pool=crypto_pool,
+            network_keypair=network_keypair,
         )
 
         self.consensus: Consensus | None = None
@@ -212,7 +214,19 @@ class PrimaryNode:
             self.block_remover,
             dag=self.dag,
         )
+        # The interoperable public edge (tonic parity): gRPC services over
+        # the same seams, mounted on consensus_api_grpc_address.
+        from .grpc_api import GrpcPublicApi
+
+        self.grpc_api = GrpcPublicApi(
+            self.name,
+            committee,
+            self.block_waiter,
+            self.block_remover,
+            dag=self.dag,
+        )
         self.api_address: str = ""
+        self.grpc_api_address: str = ""
         self._tasks: list[asyncio.Task] = []
 
     @property
@@ -236,8 +250,12 @@ class PrimaryNode:
             self._tasks.extend(await self.executor.spawn(restored))
         if self.dag is not None:
             self._tasks.append(self.dag.spawn())
+        # gRPC owns the configured public address (tonic parity); the typed
+        # TCP api binds an ephemeral port for in-framework clients.
         self.api.primary_address = self.primary.address
-        self.api_address = await self.api.spawn(
+        self.api_address = await self.api.spawn("127.0.0.1:0")
+        self.grpc_api.primary_address = self.primary.address
+        self.grpc_api_address = await self.grpc_api.spawn(
             self.parameters.consensus_api_grpc_address
         )
         # Restart catch-up (block_synchronizer/mod.rs:75-83 SynchronizeRange):
@@ -265,6 +283,7 @@ class PrimaryNode:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         await self.api.shutdown()
+        await self.grpc_api.shutdown()
         await self.primary.shutdown()
         self.storage.close()
 
@@ -282,6 +301,7 @@ class WorkerNode:
         storage: NodeStorage,
         registry: Registry | None = None,
         benchmark: bool = False,
+        network_keypair: KeyPair | None = None,
     ):
         self.registry = registry or Registry()
         self.storage = storage
@@ -294,6 +314,7 @@ class WorkerNode:
             storage.batch_store,
             registry=self.registry,
             benchmark=benchmark,
+            network_keypair=network_keypair,
         )
 
     async def spawn(self) -> None:
@@ -316,10 +337,12 @@ class NodeRestarter:
         parameters: Parameters,
         store_factory=None,
         execution_state_factory=None,
+        network_keypair: KeyPair | None = None,
     ):
         self.keypair = keypair
         self.worker_cache = worker_cache
         self.parameters = parameters
+        self.network_keypair = network_keypair
         self.store_factory = store_factory or (lambda epoch: NodeStorage(None))
         self.execution_state_factory = execution_state_factory
         self.node: PrimaryNode | None = None
@@ -338,6 +361,7 @@ class NodeRestarter:
             self.parameters,
             storage,
             execution_state=execution_state,
+            network_keypair=self.network_keypair,
         )
         await self.node.spawn()
         return self.node

@@ -15,8 +15,10 @@ peers}.rs — three flows:
   digests above our last round from peers (`CertificatesRangeRequest`) and
   pull the certificates (mod.rs:75-83).
 
-Peer selection keeps a simple success score per peer (peers.rs weights) and
-asks the best `ask_nodes` peers concurrently, first sufficient answer wins.
+Peer selection mirrors peers.rs: every peer carries a weight that successful
+answers raise and failures halve, selection is weight-biased with jitter (so
+a recovered peer can regain standing), and payload sync rotates through the
+peers that declared availability instead of hammering the first one.
 """
 
 from __future__ import annotations
@@ -45,6 +47,38 @@ logger = logging.getLogger("narwhal.primary")
 CERTIFICATE_RESPONSES_RATIO_THRESHOLD = 0.5  # mod.rs:58
 
 
+class PeerScores:
+    """Weighted peer standing (/root/reference/primary/src/block_synchronizer/
+    peers.rs): successes add, failures halve, and selection multiplies the
+    score by a random jitter so low-scored peers are still probed
+    occasionally and can recover after an outage."""
+
+    INITIAL = 10.0
+    MIN = 0.5
+    MAX = 100.0
+
+    def __init__(self, rng: random.Random | None = None):
+        self._scores: dict[PublicKey, float] = {}
+        self._rng = rng or random
+
+    def score(self, peer: PublicKey) -> float:
+        return self._scores.get(peer, self.INITIAL)
+
+    def reward(self, peer: PublicKey) -> None:
+        self._scores[peer] = min(self.MAX, self.score(peer) + 1.0)
+
+    def penalize(self, peer: PublicKey) -> None:
+        self._scores[peer] = max(self.MIN, self.score(peer) / 2.0)
+
+    def select(
+        self, candidates: list[tuple[PublicKey, str]], count: int
+    ) -> list[tuple[PublicKey, str]]:
+        return sorted(
+            candidates,
+            key=lambda pa: -self.score(pa[0]) * self._rng.uniform(0.5, 1.0),
+        )[:count]
+
+
 class BlockSynchronizer:
     def __init__(
         self,
@@ -65,7 +99,7 @@ class BlockSynchronizer:
         self.network = network
         self.parameters = parameters
         self.tx_loopback = tx_loopback
-        self._scores: dict[PublicKey, int] = defaultdict(int)  # peers.rs
+        self.peers = PeerScores()  # peers.rs standing
 
     # -- peer selection ---------------------------------------------------
 
@@ -74,9 +108,7 @@ class BlockSynchronizer:
             (pk, address)
             for pk, address, _net in self.committee.others_primaries(self.name)
         ]
-        random.shuffle(others)
-        others.sort(key=lambda pa: -self._scores[pa[0]])
-        return others[:count]
+        return self.peers.select(others, count)
 
     # -- certificates -----------------------------------------------------
 
@@ -110,11 +142,15 @@ class BlockSynchronizer:
             return []
 
         async def ask(peer: PublicKey, address: str) -> list[Certificate]:
-            resp: CertificatesBatchResponse = await self.network.request(
-                address, CertificatesBatchRequest(tuple(digests)), timeout=timeout
-            )
+            try:
+                resp: CertificatesBatchResponse = await self.network.request(
+                    address, CertificatesBatchRequest(tuple(digests)), timeout=timeout
+                )
+            except (RpcError, OSError, asyncio.TimeoutError):
+                self.peers.penalize(peer)
+                raise
             got = [c for _, c in resp.certificates if c is not None]
-            self._scores[peer] += 1
+            self.peers.reward(peer)
             return got
 
         tasks = [asyncio.ensure_future(ask(p, a)) for p, a in peers]
@@ -154,37 +190,50 @@ class BlockSynchronizer:
         self, certificates: list[Certificate], timeout: float | None = None
     ) -> list[Certificate]:
         """Ensure the payload of each certificate is available in our
-        workers' stores; returns the certificates whose payload arrived."""
+        workers' stores; returns the certificates whose payload arrived.
+
+        Retry loop with availability rotation (peers.rs + mod.rs:900-1050):
+        each attempt targets the NEXT peer that declared availability for a
+        still-missing payload, so one unresponsive provider cannot stall the
+        sync until the outer timeout."""
         timeout = timeout or self.parameters.sync_retry_delay * 4
-        pending = [
-            c
-            for c in certificates
-            if any(
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+
+        def missing(cert: Certificate) -> bool:
+            return any(
                 not self.payload_store.contains(bd, wid)
-                for bd, wid in c.header.payload.items()
+                for bd, wid in cert.header.payload.items()
             )
-        ]
+
+        pending = [c for c in certificates if missing(c)]
+        providers: dict[Digest, list[PublicKey]] = {}
         if pending:
-            providers = await self._payload_providers(pending, timeout)
-            await self._request_worker_sync(pending, providers)
+            providers = await self._payload_providers(
+                pending, min(timeout, self.parameters.sync_retry_delay * 2)
+            )
 
-        async def wait_for(cert: Certificate) -> Certificate | None:
-            try:
-                await asyncio.wait_for(
-                    asyncio.gather(
-                        *(
-                            self.payload_store.notify_contains(bd, wid)
-                            for bd, wid in cert.header.payload.items()
-                        )
-                    ),
-                    timeout,
-                )
-                return cert
-            except asyncio.TimeoutError:
-                return None
-
-        results = await asyncio.gather(*(wait_for(c) for c in certificates))
-        return [c for c in results if c is not None]
+        attempt = 0
+        while pending and loop.time() < deadline:
+            await self._request_worker_sync(pending, providers, attempt)
+            # Wait for arrivals until the retry tick, then rotate targets.
+            waiters = [
+                self.payload_store.notify_contains(bd, wid)
+                for c in pending
+                for bd, wid in c.header.payload.items()
+                if not self.payload_store.contains(bd, wid)
+            ]
+            interval = min(
+                self.parameters.sync_retry_delay, max(0.0, deadline - loop.time())
+            )
+            if waiters:
+                try:
+                    await asyncio.wait_for(asyncio.gather(*waiters), interval)
+                except asyncio.TimeoutError:
+                    pass  # wait_for already cancelled the gather
+            pending = [c for c in pending if missing(c)]
+            attempt += 1
+        return [c for c in certificates if not missing(c)]
 
     async def _payload_providers(
         self, certificates: list[Certificate], timeout: float
@@ -195,31 +244,36 @@ class BlockSynchronizer:
         providers: dict[Digest, list[PublicKey]] = defaultdict(list)
 
         async def ask(peer: PublicKey, address: str) -> None:
-            resp: PayloadAvailabilityResponse = await self.network.request(
-                address, PayloadAvailabilityRequest(digests), timeout=timeout
-            )
+            try:
+                resp: PayloadAvailabilityResponse = await self.network.request(
+                    address, PayloadAvailabilityRequest(digests), timeout=timeout
+                )
+            except (RpcError, OSError, asyncio.TimeoutError):
+                self.peers.penalize(peer)
+                return
             for digest, available in resp.available:
                 if available:
                     providers[digest].append(peer)
-            self._scores[peer] += 1
+            self.peers.reward(peer)
 
-        await asyncio.gather(
-            *(ask(p, a) for p, a in peers), return_exceptions=True
-        )
+        await asyncio.gather(*(ask(p, a) for p, a in peers))
         return providers
 
     async def _request_worker_sync(
         self,
         certificates: list[Certificate],
         providers: dict[Digest, list[PublicKey]],
+        attempt: int = 0,
     ) -> None:
-        """Tell our workers which batches to pull and from whom."""
+        """Tell our workers which batches to pull and from whom; `attempt`
+        rotates through each payload's available providers (falling back to
+        the certificate author) so retries fail over to a different peer."""
         by_worker: dict[int, dict[PublicKey, list[Digest]]] = defaultdict(
             lambda: defaultdict(list)
         )
         for cert in certificates:
             targets = providers.get(cert.digest) or [cert.origin]
-            target = targets[0]
+            target = targets[attempt % len(targets)]
             for batch_digest, worker_id in cert.header.payload.items():
                 if not self.payload_store.contains(batch_digest, worker_id):
                     by_worker[worker_id][target].append(batch_digest)
@@ -247,15 +301,19 @@ class BlockSynchronizer:
 
         async def ask(peer: PublicKey, address: str) -> None:
             nonlocal answers
-            resp: CertificatesRangeResponse = await self.network.request(
-                address, req, timeout=timeout
-            )
+            try:
+                resp: CertificatesRangeResponse = await self.network.request(
+                    address, req, timeout=timeout
+                )
+            except (RpcError, OSError, asyncio.TimeoutError):
+                self.peers.penalize(peer)
+                return
             answers += 1
             for digest in resp.digests:
                 counts[digest] += 1
-            self._scores[peer] += 1
+            self.peers.reward(peer)
 
-        await asyncio.gather(*(ask(p, a) for p, a in peers), return_exceptions=True)
+        await asyncio.gather(*(ask(p, a) for p, a in peers))
         if answers == 0:
             return []
         threshold = max(1, int(answers * CERTIFICATE_RESPONSES_RATIO_THRESHOLD))

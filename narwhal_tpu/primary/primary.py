@@ -59,6 +59,7 @@ class Primary:
         network_model: NetworkModel = NetworkModel.PARTIALLY_SYNCHRONOUS,
         registry: Registry | None = None,
         crypto_pool=None,  # AsyncVerifierPool: enables the pre-verify stage
+        network_keypair=None,
     ):
         self.name = name
         self.committee = committee
@@ -68,8 +69,22 @@ class Primary:
         self.registry = registry or Registry()
         self.metrics = PrimaryMetrics(self.registry)
 
-        self.network = NetworkClient()
-        self.server = RpcServer(parameters.max_concurrent_requests)
+        # Transport identity (the anemo PeerId model, p2p.rs:26-158): with a
+        # network keypair the primary mesh requires the mutual handshake;
+        # without one (bare component tests) it runs open.
+        self.network_keypair = network_keypair
+        credentials = None
+        if network_keypair is not None:
+            from ..network import Credentials, committee_resolver
+
+            credentials = Credentials(
+                network_keypair,
+                committee_resolver(lambda: self.committee, lambda: self.worker_cache),
+            )
+        self.network = NetworkClient(credentials=credentials)
+        self.server = RpcServer(
+            parameters.max_concurrent_requests, auth_keypair=network_keypair
+        )
         self._tasks: list[asyncio.Task] = []
 
         # Channels (primary.rs:104-151).
@@ -195,19 +210,32 @@ class Primary:
         bound = await self.server.start(host, int(port))
         self.address = f"{host}:{bound}"
 
-        # PrimaryToPrimary plane.
-        self.server.route(HeaderMsg, self._on_header)
-        self.server.route(VoteMsg, self._on_vote)
-        self.server.route(CertificateMsg, self._on_certificate)
-        self.server.route(CertificatesBatchRequest, self.helper.on_certificates_batch)
-        self.server.route(CertificatesRangeRequest, self.helper.on_certificates_range)
+        # PrimaryToPrimary plane: any committee primary's network identity.
+        # WorkerToPrimary plane (digests + reconfigure): ONLY our own workers
+        # (worker/src/primary_connector.rs; state path state_handler.rs).
+        allow_peer_primary = self._allow_peer_primary if self.network_keypair else None
+        allow_own_worker = self._allow_own_worker if self.network_keypair else None
+        self.server.route(HeaderMsg, self._on_header, allow=allow_peer_primary)
+        self.server.route(VoteMsg, self._on_vote, allow=allow_peer_primary)
+        self.server.route(CertificateMsg, self._on_certificate, allow=allow_peer_primary)
         self.server.route(
-            PayloadAvailabilityRequest, self.helper.on_payload_availability
+            CertificatesBatchRequest,
+            self.helper.on_certificates_batch,
+            allow=allow_peer_primary,
         )
-        # WorkerToPrimary plane.
-        self.server.route(OurBatchMsg, self._on_our_batch)
-        self.server.route(OthersBatchMsg, self._on_others_batch)
-        self.server.route(ReconfigureMsg, self._on_reconfigure)
+        self.server.route(
+            CertificatesRangeRequest,
+            self.helper.on_certificates_range,
+            allow=allow_peer_primary,
+        )
+        self.server.route(
+            PayloadAvailabilityRequest,
+            self.helper.on_payload_availability,
+            allow=allow_peer_primary,
+        )
+        self.server.route(OurBatchMsg, self._on_our_batch, allow=allow_own_worker)
+        self.server.route(OthersBatchMsg, self._on_others_batch, allow=allow_own_worker)
+        self.server.route(ReconfigureMsg, self._on_reconfigure, allow=allow_own_worker)
 
         self._tasks = [
             self.core.spawn(),
@@ -221,6 +249,33 @@ class Primary:
         logger.info(
             "Primary %s successfully booted on %s", self.name.hex()[:16], self.address
         )
+
+    # -- authorization predicates ------------------------------------------
+    # Allowed-key sets are cached per (committee, worker_cache) object so the
+    # hot protocol plane pays a tuple compare per frame, not an O(N) scan;
+    # epoch changes swap the objects and invalidate the cache.
+    def _auth_sets(self) -> tuple[frozenset, frozenset]:
+        key = (id(self.committee), id(self.worker_cache))
+        cached = getattr(self, "_auth_cache", None)
+        if cached is None or cached[0] != key:
+            primaries = frozenset(
+                a.network_key for a in self.committee.authorities.values()
+            )
+            workers = frozenset(
+                info.name
+                for info in self.worker_cache.our_workers(self.name).values()
+            )
+            cached = (key, primaries, workers)
+            self._auth_cache = cached
+        return cached[1], cached[2]
+
+    def _allow_peer_primary(self, peer) -> bool:
+        """Any committee authority's primary network identity."""
+        return peer.key is not None and peer.key in self._auth_sets()[0]
+
+    def _allow_own_worker(self, peer) -> bool:
+        """Only our own authority's workers."""
+        return peer.key is not None and peer.key in self._auth_sets()[1]
 
     # -- handlers ----------------------------------------------------------
     async def _ingest(self, msg) -> None:

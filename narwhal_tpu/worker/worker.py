@@ -66,6 +66,7 @@ class Worker:
         store: BatchStore,
         registry: Registry | None = None,
         benchmark: bool = False,
+        network_keypair=None,
     ):
         self.name = name
         self.worker_id = worker_id
@@ -77,8 +78,23 @@ class Worker:
         self.metrics = WorkerMetrics(self.registry)
         self.benchmark = benchmark
 
-        self.network = NetworkClient()
-        self.server = RpcServer(parameters.max_concurrent_requests)
+        # Transport identity (worker.rs:137-146 registers worker network keys
+        # as known anemo peers). With a keypair the mesh server requires the
+        # mutual handshake and the client authenticates to peers; without one
+        # (bare component tests) the mesh runs open.
+        self.network_keypair = network_keypair
+        credentials = None
+        if network_keypair is not None:
+            from ..network import Credentials, committee_resolver
+
+            credentials = Credentials(
+                network_keypair,
+                committee_resolver(lambda: self.committee, lambda: self.worker_cache),
+            )
+        self.network = NetworkClient(credentials=credentials)
+        self.server = RpcServer(
+            parameters.max_concurrent_requests, auth_keypair=network_keypair
+        )
         self.tx_server = RpcServer(parameters.max_concurrent_requests)
         self.rx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
         self._tasks: list[asyncio.Task] = []
@@ -99,15 +115,36 @@ class Worker:
         thost, tport = me.transactions.rsplit(":", 1)
         tbound = await self.tx_server.start(thost, int(tport))
         self.transactions_address = f"{thost}:{tbound}"
+        # Interoperable gRPC ingest (the reference's tonic Transactions
+        # service, worker.rs:369-423) alongside the high-throughput typed
+        # ingest; ephemeral port, surfaced via grpc_transactions_address.
+        from ..grpc_api import GrpcTransactions
 
-        # Route the three planes.
-        self.server.route(WorkerBatchMsg, self._on_peer_batch)
-        self.server.route(WorkerBatchRequest, self._on_batch_request)
-        self.server.route(SynchronizeMsg, self._on_synchronize)
-        self.server.route(CleanupMsg, self._on_cleanup)
-        self.server.route(RequestBatchMsg, self._on_request_batch)
-        self.server.route(DeleteBatchesMsg, self._on_delete_batches)
-        self.server.route(ReconfigureMsg, self._on_reconfigure)
+        self.grpc_transactions = GrpcTransactions(self.tx_batch_maker, self.metrics)
+        self.grpc_transactions_address = await self.grpc_transactions.spawn(
+            f"{thost}:0"
+        )
+
+        # Route the three planes with the authorization matrix: batch planes
+        # accept same-lane workers of any committee member, the control plane
+        # (sync/cleanup/delete/reconfigure — worker/src/worker.rs:137-146,
+        # synchronizer.rs:215-282) ONLY our own primary. Predicates read
+        # self.committee/worker_cache live, so epoch changes apply.
+        allow_peer_worker = self._allow_peer_worker if self.network_keypair else None
+        allow_own_primary = self._allow_own_primary if self.network_keypair else None
+        self.server.route(WorkerBatchMsg, self._on_peer_batch, allow=allow_peer_worker)
+        self.server.route(
+            WorkerBatchRequest, self._on_batch_request, allow=allow_peer_worker
+        )
+        self.server.route(SynchronizeMsg, self._on_synchronize, allow=allow_own_primary)
+        self.server.route(CleanupMsg, self._on_cleanup, allow=allow_own_primary)
+        self.server.route(
+            RequestBatchMsg, self._on_request_batch, allow=allow_own_primary
+        )
+        self.server.route(
+            DeleteBatchesMsg, self._on_delete_batches, allow=allow_own_primary
+        )
+        self.server.route(ReconfigureMsg, self._on_reconfigure, allow=allow_own_primary)
         self.tx_server.route(SubmitTransactionMsg, self._on_tx)
         self.tx_server.route(SubmitTransactionStreamMsg, self._on_tx_stream)
 
@@ -173,6 +210,35 @@ class Worker:
         )
 
     # -- handlers ---------------------------------------------------------
+    # -- authorization predicates (handshake-verified peer identity) -------
+    # Allowed-key sets cached per (committee, worker_cache) object: a tuple
+    # compare per frame on the hot batch plane, invalidated on epoch change.
+    def _auth_sets(self) -> tuple[frozenset, frozenset]:
+        key = (id(self.committee), id(self.worker_cache))
+        cached = getattr(self, "_auth_cache", None)
+        if cached is None or cached[0] != key:
+            lane = frozenset(
+                {self.worker_cache.worker(self.name, self.worker_id).name}
+                | {
+                    info.name
+                    for _, info in self.worker_cache.others_workers(
+                        self.name, self.worker_id
+                    )
+                }
+            )
+            own_primary = frozenset({self.committee.network_key(self.name)})
+            cached = (key, lane, own_primary)
+            self._auth_cache = cached
+        return cached[1], cached[2]
+
+    def _allow_peer_worker(self, peer) -> bool:
+        """Same-lane workers of any committee authority (incl. ourselves)."""
+        return peer.key is not None and peer.key in self._auth_sets()[0]
+
+    def _allow_own_primary(self, peer) -> bool:
+        """Control-plane frames: only our own authority's primary."""
+        return peer.key is not None and peer.key in self._auth_sets()[1]
+
     async def _on_peer_batch(self, msg: WorkerBatchMsg, peer: str):
         self.metrics.batches_received.inc()
         await self.tx_others_processor.send((msg.serialized_batch, False))
@@ -240,4 +306,6 @@ class Worker:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         await self.server.stop()
         await self.tx_server.stop()
+        if hasattr(self, "grpc_transactions"):
+            await self.grpc_transactions.shutdown()
         self.network.close()

@@ -1,0 +1,235 @@
+"""Transport authentication: rogue sockets must not reach validator-internal
+handlers, and authorization is per-role.
+
+Reference behavior: the anemo mesh only accepts connections from known
+ed25519 PeerIds (/root/reference/network/src/p2p.rs:26-158,
+worker/src/worker.rs:137-146), so a random socket can never deliver a
+Reconfigure("shutdown") or DeleteBatches to a worker. These tests prove the
+same for the handshake-authenticated TCP mesh.
+"""
+
+import asyncio
+
+from narwhal_tpu.config import WorkerInfo
+from narwhal_tpu.crypto import KeyPair
+from narwhal_tpu.fixtures import CommitteeFixture
+from narwhal_tpu.messages import (
+    CleanupMsg,
+    DeleteBatchesMsg,
+    ReconfigureMsg,
+    SynchronizeMsg,
+    WorkerBatchRequest,
+)
+from narwhal_tpu.network import (
+    Credentials,
+    NetworkClient,
+    RpcError,
+    RpcServer,
+    committee_resolver,
+)
+from narwhal_tpu.stores import NodeStorage
+from narwhal_tpu.worker import Worker
+
+
+async def _spawn_authed_worker(f: CommitteeFixture, index: int = 0) -> Worker:
+    a = f.authorities[index]
+    worker = Worker(
+        a.public,
+        0,
+        f.committee,
+        f.worker_cache,
+        f.parameters,
+        NodeStorage(None).batch_store,
+        network_keypair=a.worker_keypairs[0],
+    )
+    await worker.spawn()
+    # Publish the bound mesh address so resolvers map it to the worker's key.
+    info = f.worker_cache.workers[a.public][0]
+    f.worker_cache.workers[a.public][0] = WorkerInfo(
+        name=info.name,
+        transactions=worker.transactions_address,
+        worker_address=worker.worker_address,
+    )
+    return worker
+
+
+def _credentials(f: CommitteeFixture, keypair: KeyPair) -> Credentials:
+    return Credentials(
+        keypair, committee_resolver(lambda: f.committee, lambda: f.worker_cache)
+    )
+
+
+def test_rogue_socket_cannot_shutdown_worker(run):
+    """An unauthenticated socket can neither shut a worker down nor purge
+    its store; the worker keeps serving its own (authenticated) primary."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        worker = await _spawn_authed_worker(f)
+        rogue = NetworkClient()
+        own_primary = NetworkClient(
+            credentials=_credentials(f, f.authorities[0].network_keypair)
+        )
+        try:
+            ok = await rogue.unreliable_send(
+                worker.worker_address, ReconfigureMsg("shutdown", ""), timeout=2.0
+            )
+            assert not ok, "rogue shutdown must be rejected"
+            ok = await rogue.unreliable_send(
+                worker.worker_address, DeleteBatchesMsg((b"\x01" * 32,)), timeout=2.0
+            )
+            assert not ok, "rogue delete must be rejected"
+            assert worker.rx_reconfigure.value.kind == "boot"
+
+            # The worker still serves its own primary after the attacks.
+            assert await own_primary.unreliable_send(
+                worker.worker_address, CleanupMsg(1), timeout=5.0
+            )
+        finally:
+            rogue.close()
+            own_primary.close()
+            await worker.shutdown()
+
+    run(scenario())
+
+
+def test_wrong_role_is_unauthorized(run):
+    """A *valid committee identity of the wrong role* is authenticated but
+    not authorized: a peer authority's primary cannot drive this worker's
+    control plane, while the same-lane peer worker may use the batch plane."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        worker = await _spawn_authed_worker(f)
+        peer_primary = NetworkClient(
+            credentials=_credentials(f, f.authorities[1].network_keypair)
+        )
+        peer_worker = NetworkClient(
+            credentials=_credentials(f, f.authorities[1].worker_keypairs[0])
+        )
+        try:
+            try:
+                await peer_primary.request(
+                    worker.worker_address,
+                    SynchronizeMsg((b"\x02" * 32,), f.authorities[1].public),
+                    timeout=2.0,
+                )
+                raise AssertionError("peer primary must not drive Synchronize")
+            except RpcError as e:
+                assert "unauthorized" in str(e)
+            try:
+                await peer_primary.request(
+                    worker.worker_address, DeleteBatchesMsg((b"\x03" * 32,)), timeout=2.0
+                )
+                raise AssertionError("peer primary must not delete batches")
+            except RpcError as e:
+                assert "unauthorized" in str(e)
+
+            # Batch plane: the same-lane peer worker is allowed.
+            resp = await peer_worker.request(
+                worker.worker_address, WorkerBatchRequest((b"\x04" * 32,)), timeout=5.0
+            )
+            assert resp is not None
+        finally:
+            peer_primary.close()
+            peer_worker.close()
+            await worker.shutdown()
+
+    run(scenario())
+
+
+def test_session_mac_rejects_forged_and_replayed_frames():
+    """Post-handshake frames are MAC'd per direction with a sequence number:
+    a relay that forwarded the handshake verbatim still cannot inject,
+    tamper with, or replay frames (it never learns the X25519 shared
+    secret, so it cannot produce a valid tag)."""
+    import pytest
+
+    from narwhal_tpu.network.auth import AuthError, Session
+
+    import os
+
+    k_c2s, k_s2c = os.urandom(32), os.urandom(32)
+    client = Session(send_key=k_c2s, recv_key=k_s2c)
+    server = Session(send_key=k_s2c, recv_key=k_c2s)
+
+    body = b"hello-frame"
+    mac = client.seal(0, 1, 7, body)
+    server.open(0, 1, 7, body, mac)  # legitimate frame passes
+
+    # Tampered body.
+    mac2 = client.seal(0, 2, 7, body)
+    with pytest.raises(AuthError):
+        server.open(0, 2, 7, b"evil-frame!", mac2)
+    # Injected frame with a guessed tag.
+    with pytest.raises(AuthError):
+        server.open(0, 3, 7, b"inject", b"\x00" * 16)
+    # Replay of the first frame (stale sequence number).
+    with pytest.raises(AuthError):
+        server.open(0, 1, 7, body, mac)
+
+
+def test_authenticated_request_roundtrip_uses_macs(run):
+    """A credentialed request to an auth server succeeds end-to-end (frames
+    sealed both ways), and a plaintext frame injected onto the authenticated
+    server port is torn down, not dispatched."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        worker = await _spawn_authed_worker(f)
+        own_primary = NetworkClient(
+            credentials=_credentials(f, f.authorities[0].network_keypair)
+        )
+        try:
+            assert await own_primary.unreliable_send(
+                worker.worker_address, CleanupMsg(3), timeout=5.0
+            )
+            # Raw plaintext frame straight at the authed port: no dispatch.
+            host, port = worker.worker_address.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            import struct
+
+            body = b""
+            writer.write(struct.pack("<IBQH", len(body), 0, 1, CleanupMsg.TAG) + body)
+            await writer.drain()
+            # Server drops the connection (handshake never completed).
+            got = await asyncio.wait_for(reader.read(1024), 6.0)
+            # Either immediate close, or only the HELLO frame then close.
+            assert b"" == got or got[4:5] == b"\x03", got
+            writer.close()
+        finally:
+            own_primary.close()
+            await worker.shutdown()
+
+    run(scenario())
+
+
+def test_client_rejects_wrong_server_identity(run):
+    """A server presenting a key other than the committee's entry for that
+    address (MITM / misdirected connection) is refused by the client."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        imposter = RpcServer(auth_keypair=KeyPair.generate())
+        port = await imposter.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{port}"
+        # Committee claims authority 0's primary lives at the imposter's port.
+        from narwhal_tpu.config import Authority
+
+        pk = f.authorities[0].public
+        auth = f.committee.authorities[pk]
+        f.committee.authorities[pk] = Authority(auth.stake, addr, auth.network_key)
+        client = NetworkClient(
+            credentials=_credentials(f, f.authorities[1].network_keypair)
+        )
+        try:
+            try:
+                await client.request(addr, CleanupMsg(1), timeout=2.0)
+                raise AssertionError("client must refuse a wrong server identity")
+            except RpcError as e:
+                assert "handshake" in str(e)
+        finally:
+            client.close()
+            await imposter.stop()
+
+    run(scenario())

@@ -1,0 +1,188 @@
+"""Block-services scenarios mirroring
+/root/reference/primary/src/block_synchronizer/tests/: certificates that
+exist only on peers, unresponsive-peer failover with score demotion, and
+payload availability rotation across providers.
+"""
+
+import asyncio
+
+from narwhal_tpu.config import Authority, WorkerInfo
+from narwhal_tpu.fixtures import CommitteeFixture
+from narwhal_tpu.messages import (
+    CertificatesBatchRequest,
+    CertificatesBatchResponse,
+    PayloadAvailabilityRequest,
+    PayloadAvailabilityResponse,
+    SynchronizeMsg,
+)
+from narwhal_tpu.network import NetworkClient, RpcServer
+from narwhal_tpu.primary.block_synchronizer import BlockSynchronizer, PeerScores
+from narwhal_tpu.stores import NodeStorage
+
+
+async def _mock_peer_primary(f, index, certs_by_digest, available=()):
+    """A scripted peer primary serving CertificatesBatch and
+    PayloadAvailability (the PrimaryToPrimaryMockServer pattern,
+    test_utils/src/lib.rs:176-359)."""
+    srv = RpcServer()
+
+    async def on_batch(msg: CertificatesBatchRequest, peer):
+        return CertificatesBatchResponse(
+            tuple((d, certs_by_digest.get(d)) for d in msg.digests)
+        )
+
+    async def on_availability(msg: PayloadAvailabilityRequest, peer):
+        return PayloadAvailabilityResponse(
+            tuple((d, d in available) for d in msg.digests)
+        )
+
+    srv.route(CertificatesBatchRequest, on_batch)
+    srv.route(PayloadAvailabilityRequest, on_availability)
+    port = await srv.start("127.0.0.1", 0)
+    pk = f.authorities[index].public
+    auth = f.committee.authorities[pk]
+    f.committee.authorities[pk] = Authority(
+        auth.stake, f"127.0.0.1:{port}", auth.network_key
+    )
+    return srv
+
+
+def _make_sync(f, tx_loopback=None):
+    storage = NodeStorage(None)
+    sync = BlockSynchronizer(
+        f.authorities[0].public,
+        f.committee,
+        f.worker_cache,
+        storage.certificate_store,
+        storage.payload_store,
+        NetworkClient(),
+        f.parameters,
+        tx_loopback=tx_loopback,
+    )
+    return sync, storage
+
+
+def test_fetch_certificates_held_only_by_peers(run):
+    """A certificate absent locally is fetched from whichever peer has it,
+    verified, and looped back to the Core (handler.rs:200-260)."""
+
+    async def scenario():
+        from narwhal_tpu.channels import Channel
+
+        f = CommitteeFixture(size=4, workers=1)
+        cert = f.certificate(f.header(author=1, round=1))
+        servers = [
+            await _mock_peer_primary(f, 1, {}),  # peer without it
+            await _mock_peer_primary(f, 2, {cert.digest: cert}),  # peer with it
+            await _mock_peer_primary(f, 3, {}),
+        ]
+        loopback = Channel(10)
+        sync, _ = _make_sync(f, tx_loopback=loopback)
+        try:
+            got = await sync.synchronize_block_headers([cert.digest], timeout=5.0)
+            assert [c.digest for c in got] == [cert.digest]
+            injected = await asyncio.wait_for(loopback.recv(), 2.0)
+            assert injected.digest == cert.digest
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_unresponsive_peer_is_penalized_and_failed_over(run):
+    """One peer address is dead: the fetch still succeeds from the others
+    and the dead peer's standing drops below theirs (peers.rs weights)."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        cert = f.certificate(f.header(author=1, round=1))
+        dead_pk = f.authorities[1].public
+        # Point the dead peer at a port nothing listens on.
+        auth = f.committee.authorities[dead_pk]
+        f.committee.authorities[dead_pk] = Authority(
+            auth.stake, "127.0.0.1:1", auth.network_key
+        )
+        servers = [
+            await _mock_peer_primary(f, 2, {cert.digest: cert}),
+            await _mock_peer_primary(f, 3, {cert.digest: cert}),
+        ]
+        sync, _ = _make_sync(f)
+        try:
+            got = await sync.synchronize_block_headers([cert.digest], timeout=5.0)
+            assert [c.digest for c in got] == [cert.digest]
+            dead_score = sync.peers.score(dead_pk)
+            live_scores = [
+                sync.peers.score(f.authorities[i].public) for i in (2, 3)
+            ]
+            assert dead_score < min(live_scores), (dead_score, live_scores)
+            assert dead_score < PeerScores.INITIAL
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_payload_sync_rotates_providers(run):
+    """Two peers declare payload availability; the first Synchronize attempt
+    targets one, and when nothing arrives the retry targets the OTHER
+    (availability rotation, vs. round 1's providers[0] forever)."""
+
+    async def scenario():
+        from dataclasses import replace
+
+        f = CommitteeFixture(size=4, workers=1)
+        batch_digest = b"\x07" * 32
+        cert = f.certificate(f.header(author=1, round=1, payload={batch_digest: 0}))
+
+        servers = [
+            await _mock_peer_primary(f, 1, {}, available={cert.digest}),
+            await _mock_peer_primary(f, 2, {}, available={cert.digest}),
+            await _mock_peer_primary(f, 3, {}, available=()),
+        ]
+        # Our own worker: capture Synchronize targets.
+        targets = []
+        worker_srv = RpcServer()
+
+        async def on_sync(msg: SynchronizeMsg, peer):
+            targets.append(msg.target)
+
+        worker_srv.route(SynchronizeMsg, on_sync)
+        wport = await worker_srv.start("127.0.0.1", 0)
+        me = f.authorities[0].public
+        info = f.worker_cache.workers[me][0]
+        f.worker_cache.workers[me][0] = WorkerInfo(
+            name=info.name,
+            transactions=info.transactions,
+            worker_address=f"127.0.0.1:{wport}",
+        )
+
+        f.parameters = replace(f.parameters, sync_retry_delay=0.1)
+        sync, storage = _make_sync(f)
+        try:
+            done = await sync.synchronize_block_payloads([cert], timeout=0.5)
+            assert done == []  # nothing ever arrived
+            distinct = set(targets)
+            assert len(targets) >= 2, targets
+            assert len(distinct) >= 2, "retries must rotate to another provider"
+            assert distinct <= {f.authorities[1].public, f.authorities[2].public}
+
+            # Now the payload arrives: the sync completes promptly.
+            async def deliver():
+                await asyncio.sleep(0.05)
+                storage.payload_store.write(batch_digest, 0)
+
+            task = asyncio.ensure_future(deliver())
+            done = await sync.synchronize_block_payloads([cert], timeout=2.0)
+            assert [c.digest for c in done] == [cert.digest]
+            await task
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+            await worker_srv.stop()
+
+    run(scenario())

@@ -202,9 +202,22 @@ def test_cluster_with_verification_pool(run):
                 signers=(0, 1, 2),
                 signatures=(b"\x00" * 64, b"\x01" * 64, b"\x02" * 64),
             )
-            await client.unreliable_send(
+            # Deliver it as an authenticated committee peer so it passes
+            # transport auth and exercises signature verification.
+            from narwhal_tpu.network import Credentials, committee_resolver
+
+            peer_client = NetworkClient(
+                credentials=Credentials(
+                    cluster.fixture.authorities[0].network_keypair,
+                    committee_resolver(
+                        lambda: cluster.committee, lambda: cluster.worker_cache
+                    ),
+                )
+            )
+            await peer_client.unreliable_send(
                 cluster.authorities[1].primary.address, CertificateMsg(forged)
             )
+            peer_client.close()
 
             rounds = await cluster.assert_progress(commit_threshold=3, timeout=30.0)
             assert all(r >= 3 for r in rounds.values())

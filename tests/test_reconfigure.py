@@ -41,9 +41,25 @@ def test_in_band_epoch_change(run):
     certificates (epoch_change.rs simple_epoch_change)."""
 
     async def scenario():
+        from narwhal_tpu.network import Credentials, committee_resolver
+
         cluster = Cluster(size=4, workers=1)
         await cluster.start()
-        client = NetworkClient()
+        # Reconfigure is worker->primary control plane: each primary only
+        # accepts it from its own workers, so impersonate each authority's
+        # worker 0 (the reference app drives it through the worker,
+        # state_handler.rs:100-172).
+        clients = [
+            NetworkClient(
+                credentials=Credentials(
+                    fixture_auth.worker_keypairs[0],
+                    committee_resolver(
+                        lambda: cluster.committee, lambda: cluster.worker_cache
+                    ),
+                )
+            )
+            for fixture_auth in cluster.fixture.authorities
+        ]
         try:
             await cluster.assert_progress(commit_threshold=2, timeout=30.0)
             for epoch in (1, 2):
@@ -53,11 +69,12 @@ def test_in_band_epoch_change(run):
                 doc = json.loads(new_committee)
                 doc["epoch"] = epoch
                 msg = ReconfigureMsg("new_epoch", json.dumps(doc))
-                for a in cluster.authorities:
-                    await client.unreliable_send(a.primary.address, msg)
+                for a, client in zip(cluster.authorities, clients):
+                    assert await client.unreliable_send(a.primary.address, msg)
                 await _wait_epoch_progress(cluster, epoch, 6, timeout=30.0)
         finally:
-            client.close()
+            for client in clients:
+                client.close()
             await cluster.shutdown()
 
     run(scenario(), timeout=120.0)
