@@ -1,0 +1,59 @@
+"""Aggregate repeated benchmark runs into mean/std records (the reference's
+benchmark/benchmark/aggregate.py).
+
+    python -m benchmark.aggregate run1.json run2.json run3.json --out agg.json
+
+Runs are grouped by (committee_size, workers_per_node, faults, input_rate,
+tx_size); numeric fields get `<key>` = mean and `<key>_std` = sample std.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+from statistics import mean, stdev
+
+GROUP_KEYS = ("committee_size", "workers_per_node", "faults", "input_rate", "tx_size")
+
+
+def aggregate(records: list[dict]) -> list[dict]:
+    groups: dict[tuple, list[dict]] = defaultdict(list)
+    for r in records:
+        groups[tuple(r.get(k) for k in GROUP_KEYS)].append(r)
+    out = []
+    for key, rs in sorted(groups.items(), key=lambda kv: str(kv[0])):
+        agg: dict = dict(zip(GROUP_KEYS, key))
+        agg["runs"] = len(rs)
+        numeric = {
+            k
+            for r in rs
+            for k, v in r.items()
+            if isinstance(v, (int, float)) and k not in GROUP_KEYS
+        }
+        for k in sorted(numeric):
+            vals = [r[k] for r in rs if k in r]
+            agg[k] = mean(vals)
+            agg[k + "_std"] = stdev(vals) if len(vals) > 1 else 0.0
+        out.append(agg)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.aggregate")
+    ap.add_argument("files", nargs="+")
+    ap.add_argument("--out", default=".bench/aggregate.json")
+    args = ap.parse_args()
+    records: list[dict] = []
+    for path in args.files:
+        with open(path) as f:
+            data = json.load(f)
+        records.extend(data if isinstance(data, list) else [data])
+    result = aggregate(records)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"aggregated {len(records)} runs into {len(result)} groups -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -163,4 +163,6 @@ class LocalBench:
             time.sleep(bench.duration)
         finally:
             self._kill_all()
-        return LogParser.process(self.base, faults=bench.faults)
+        return LogParser.process(
+            self.base, faults=bench.faults, parameters=self.node_parameters
+        )

@@ -45,8 +45,10 @@ class LogParser:
         primaries: list[str],
         workers: list[str],
         faults: int = 0,
+        parameters=None,  # narwhal_tpu.config.Parameters: echoed in SUMMARY
     ):
         self.faults = faults
+        self.parameters = parameters
         self.committee_size = len(primaries) + faults
         self.workers_per_node = len(workers) // max(len(primaries), 1)
 
@@ -99,7 +101,7 @@ class LogParser:
                 self.received_samples[int(i)] = d
 
     @classmethod
-    def process(cls, directory: str, faults: int = 0) -> "LogParser":
+    def process(cls, directory: str, faults: int = 0, parameters=None) -> "LogParser":
         def read(pattern: str) -> list[str]:
             out = []
             for path in sorted(glob.glob(os.path.join(directory, pattern))):
@@ -112,6 +114,7 @@ class LogParser:
             read("primary-*.log"),
             read("worker-*.log"),
             faults,
+            parameters=parameters,
         )
 
     # -- metrics (logs.py:165-208) ----------------------------------------
@@ -147,11 +150,44 @@ class LogParser:
                     lat.append(self.commits[batch] - sent[tx_id])
         return mean(lat) if lat else 0.0
 
+    def to_dict(self) -> dict:
+        """Machine-readable results for the sweep/plot/aggregate tooling."""
+        c_tps, c_bps, duration = self.consensus_throughput()
+        e_tps, e_bps, _ = self.end_to_end_throughput()
+        return {
+            "faults": self.faults,
+            "committee_size": self.committee_size,
+            "workers_per_node": self.workers_per_node,
+            "input_rate": sum(self.rate),
+            "tx_size": self.size,
+            "duration_s": duration,
+            "consensus_tps": c_tps,
+            "consensus_bps": c_bps,
+            "consensus_latency_ms": self.consensus_latency() * 1_000,
+            "end_to_end_tps": e_tps,
+            "end_to_end_bps": e_bps,
+            "end_to_end_latency_ms": self.end_to_end_latency() * 1_000,
+        }
+
     def result(self) -> str:
         c_tps, c_bps, duration = self.consensus_throughput()
         c_lat = self.consensus_latency() * 1_000
         e_tps, e_bps, _ = self.end_to_end_throughput()
         e_lat = self.end_to_end_latency() * 1_000
+        # Node-parameter echo (the reference SUMMARY's config block,
+        # benchmark/benchmark/logs.py:199-244).
+        params = ""
+        if self.parameters is not None:
+            p = self.parameters
+            params = (
+                f" Header size: {p.header_size:,} B\n"
+                f" Max header delay: {round(p.max_header_delay * 1000):,} ms\n"
+                f" GC depth: {p.gc_depth:,} round(s)\n"
+                f" Sync retry delay: {round(p.sync_retry_delay * 1000):,} ms\n"
+                f" Sync retry nodes: {p.sync_retry_nodes:,} node(s)\n"
+                f" batch size: {p.batch_size:,} B\n"
+                f" Max batch delay: {round(p.max_batch_delay * 1000):,} ms\n"
+            )
         return (
             "\n"
             "-----------------------------------------\n"
@@ -164,6 +200,7 @@ class LogParser:
             f" Input rate: {sum(self.rate):,} tx/s\n"
             f" Transaction size: {self.size:,} B\n"
             f" Execution time: {round(duration):,} s\n"
+            f"{params}"
             "\n"
             " + RESULTS:\n"
             f" Consensus TPS: {round(c_tps):,} tx/s\n"

@@ -1,0 +1,73 @@
+"""Latency-throughput plots from sweep/aggregate result files (the
+reference's Ploter, benchmark/benchmark/plot.py).
+
+    python -m benchmark.plot .bench/sweep.json [more.json ...] --out tps.png
+
+Each input file is one curve (labelled by its committee/worker shape);
+points are (consensus TPS, consensus latency).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def load(path: str) -> list[dict]:
+    with open(path) as f:
+        data = json.load(f)
+    return data if isinstance(data, list) else [data]
+
+
+def label_for(results: list[dict], path: str) -> str:
+    if not results:
+        return os.path.basename(path)
+    r = results[0]
+    lbl = f"{r['committee_size']} nodes, {r['workers_per_node']} worker(s)"
+    if r.get("faults"):
+        lbl += f", {r['faults']} faults"
+    return lbl
+
+
+def plot(files: list[str], out: str, e2e: bool = False) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    tps_key = "end_to_end_tps" if e2e else "consensus_tps"
+    lat_key = "end_to_end_latency_ms" if e2e else "consensus_latency_ms"
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for path in files:
+        results = sorted(load(path), key=lambda r: r[tps_key])
+        xs = [r[tps_key] / 1_000 for r in results]
+        ys = [r[lat_key] / 1_000 for r in results]
+        errs = [r.get(lat_key + "_std", 0) / 1_000 for r in results]
+        ax.errorbar(
+            xs, ys, yerr=errs if any(errs) else None,
+            marker="o", capsize=3, label=label_for(results, path),
+        )
+    kind = "End-to-end" if e2e else "Consensus"
+    ax.set_xlabel(f"{kind} throughput (k tx/s)")
+    ax.set_ylabel(f"{kind} latency (s)")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out, dpi=140)
+    plt.close(fig)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.plot")
+    ap.add_argument("files", nargs="+", help="sweep/aggregate JSON files")
+    ap.add_argument("--out", default=".bench/latency-throughput.png")
+    ap.add_argument("--e2e", action="store_true", help="plot end-to-end metrics")
+    args = ap.parse_args()
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    print("wrote", plot(args.files, args.out, args.e2e))
+
+
+if __name__ == "__main__":
+    main()

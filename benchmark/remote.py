@@ -1,0 +1,344 @@
+"""Remote multi-machine benchmark orchestration over SSH (the reference's
+`fab remote` flow, benchmark/benchmark/remote.py:33-366 + fabfile.py, with
+plain ssh/scp in place of Fabric and no cloud-provider coupling — hosts come
+from a file instead of boto3).
+
+Flow (mirroring Bench.run):
+  1. `install`   — push the repo to every host (tar over ssh) and verify the
+                   Python environment imports.
+  2. `configure` — generate keys/committee/workers/parameters with real host
+                   addresses, upload each node's config set.
+  3. `start`     — launch primaries/workers/clients under nohup on their
+                   hosts (faults f => last f nodes never start).
+  4. `stop`      — kill narwhal processes everywhere.
+  5. `logs`      — download logs and produce the same SUMMARY as the local
+                   bench (LogParser is shared).
+
+Hosts file: one "user@host" per line; node i uses line i (one validator per
+machine, its workers collocated, like the reference's default).
+
+    python -m benchmark.remote --hosts hosts.txt install
+    python -m benchmark.remote --hosts hosts.txt run --rate 50000 --duration 60
+
+The SSH transport is a small `Connection` class (run/put/get); tests inject
+`LocalConnection`, which executes the same commands through a local shell,
+so the whole orchestration logic is exercised without real machines — and a
+BASELINE.json-shape config (10-50 nodes) is buildable in principle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import time
+
+REMOTE_DIR = "~/narwhal-tpu"
+
+
+class Connection:
+    """Thin ssh/scp wrapper: run a command, push a file, pull a file."""
+
+    def __init__(self, host: str, ssh_opts: tuple[str, ...] = ("-o", "BatchMode=yes")):
+        self.host = host
+        self.ssh_opts = list(ssh_opts)
+
+    def run(
+        self, command: str, check: bool = True, capture: bool = True
+    ) -> subprocess.CompletedProcess:
+        # capture=False is for fire-and-forget background launches: waiting
+        # for pipe EOF can block on the nohup'd child, both locally and over
+        # real ssh.
+        kwargs: dict = dict(text=True, check=check, stdin=subprocess.DEVNULL)
+        if capture:
+            kwargs["capture_output"] = True
+        else:
+            kwargs["stdout"] = subprocess.DEVNULL
+            kwargs["stderr"] = subprocess.DEVNULL
+        return subprocess.run(["ssh", *self.ssh_opts, self.host, command], **kwargs)
+
+    def put(self, local: str, remote: str) -> None:
+        subprocess.run(
+            ["scp", *self.ssh_opts, local, f"{self.host}:{remote}"], check=True
+        )
+
+    def get(self, remote: str, local: str) -> None:
+        subprocess.run(
+            ["scp", *self.ssh_opts, f"{self.host}:{remote}", local], check=True
+        )
+
+
+class LocalConnection(Connection):
+    """Executes the same command surface through a local shell with a
+    per-'host' root directory — lets tests (and single-machine dry runs)
+    exercise the orchestration without sshd."""
+
+    def __init__(self, host: str, root: str):
+        super().__init__(host)
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _localize(self, text: str) -> str:
+        return text.replace("~", self.root)
+
+    def run(
+        self, command: str, check: bool = True, capture: bool = True
+    ) -> subprocess.CompletedProcess:
+        kwargs: dict = dict(text=True, check=check, stdin=subprocess.DEVNULL)
+        if capture:
+            kwargs["capture_output"] = True
+        else:
+            kwargs["stdout"] = subprocess.DEVNULL
+            kwargs["stderr"] = subprocess.DEVNULL
+        return subprocess.run(["bash", "-c", self._localize(command)], **kwargs)
+
+    def put(self, local: str, remote: str) -> None:
+        dest = self._localize(remote)
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        subprocess.run(["cp", local, dest], check=True)
+
+    def get(self, remote: str, local: str) -> None:
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        subprocess.run(["cp", self._localize(remote), local], check=True)
+
+
+class RemoteBench:
+    def __init__(
+        self,
+        hosts: list[str],
+        workers: int = 1,
+        base_port: int = 9000,
+        connection_factory=Connection,
+        work_dir: str = ".bench-remote",
+    ):
+        self.hosts = hosts
+        self.workers = workers
+        self.base_port = base_port
+        self.conns = [connection_factory(h) for h in hosts]
+        self.base = os.path.abspath(work_dir)
+        os.makedirs(self.base, exist_ok=True)
+
+    # -- 1. install --------------------------------------------------------
+    def install(self) -> None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        tarball = os.path.join(self.base, "repo.tar.gz")
+        subprocess.run(
+            [
+                "tar", "czf", tarball, "-C", repo,
+                "--exclude=.git", "--exclude=.bench*", "--exclude=__pycache__",
+                "--exclude=.jax_cache", "--exclude=.pytest_cache",
+                "narwhal_tpu", "benchmark", "native",
+            ],
+            check=True,
+        )
+        for conn in self.conns:
+            conn.run(f"mkdir -p {REMOTE_DIR}")
+            conn.put(tarball, f"{REMOTE_DIR}/repo.tar.gz")
+            conn.run(f"cd {REMOTE_DIR} && tar xzf repo.tar.gz")
+            out = conn.run(
+                f"cd {REMOTE_DIR} && python3 -c 'import narwhal_tpu; print(\"ok\")'"
+            )
+            assert "ok" in out.stdout, f"{conn.host}: environment check failed"
+
+    # -- 2. configure ------------------------------------------------------
+    def configure(self) -> dict:
+        """Generate committee/worker/key/parameter files with the hosts'
+        real addresses and upload each node's set."""
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from narwhal_tpu.config import (
+            Authority,
+            Committee,
+            Parameters,
+            WorkerCache,
+            WorkerInfo,
+        )
+        from narwhal_tpu.crypto import KeyPair
+
+        def bare_host(h: str) -> str:
+            return h.split("@", 1)[-1]
+
+        authorities, workers, key_docs = {}, {}, []
+        for i, host in enumerate(self.hosts):
+            kp, net_kp = KeyPair.generate(), KeyPair.generate()
+            worker_kps = {w: KeyPair.generate() for w in range(self.workers)}
+            key_docs.append(
+                {
+                    "name": kp.public.hex(),
+                    "seed": kp.private_bytes().hex(),
+                    "network_seed": net_kp.private_bytes().hex(),
+                    "worker_network_seeds": {
+                        str(w): k.private_bytes().hex() for w, k in worker_kps.items()
+                    },
+                }
+            )
+            addr = bare_host(host)
+            # Per-node port block: unique even when several "hosts" resolve
+            # to one machine (the LocalConnection test path).
+            port = self.base_port + i * 100
+            authorities[kp.public] = Authority(
+                stake=1, primary_address=f"{addr}:{port}", network_key=net_kp.public
+            )
+            workers[kp.public] = {
+                w: WorkerInfo(
+                    name=worker_kps[w].public,
+                    transactions=f"{addr}:{port + 1 + 2 * w}",
+                    worker_address=f"{addr}:{port + 2 + 2 * w}",
+                )
+                for w in range(self.workers)
+            }
+        committee = Committee(authorities)
+        committee.export(f"{self.base}/committee.json")
+        WorkerCache(workers).export(f"{self.base}/workers.json")
+        self.node_parameters = Parameters()
+        self.node_parameters.export(f"{self.base}/parameters.json")
+        for i, doc in enumerate(key_docs):
+            with open(f"{self.base}/key-{i}.json", "w") as f:
+                json.dump(doc, f)
+        # Upload: every host gets the shared files + its own key.
+        for i, conn in enumerate(self.conns):
+            conn.run(f"mkdir -p {REMOTE_DIR}/configs")
+            for name in ("committee.json", "workers.json", "parameters.json"):
+                conn.put(f"{self.base}/{name}", f"{REMOTE_DIR}/configs/{name}")
+            conn.put(f"{self.base}/key-{i}.json", f"{REMOTE_DIR}/configs/key.json")
+        return {"committee": committee, "workers": workers}
+
+    # -- 3/4. start / stop -------------------------------------------------
+    def _node_cmd(self, role: str, log: str, extra: str = "") -> str:
+        return (
+            f"cd {REMOTE_DIR} && nohup python3 -m narwhal_tpu -v run "
+            f"--keys configs/key.json --committee configs/committee.json "
+            f"--workers configs/workers.json --parameters configs/parameters.json "
+            f"--store db {role} {extra} < /dev/null > {log}.log 2>&1 &"
+        )
+
+    def start(self, faults: int = 0) -> None:
+        alive = self.conns[: len(self.conns) - faults]
+        for conn in alive:
+            conn.run(self._node_cmd("primary", "primary"), capture=False)
+            for w in range(self.workers):
+                conn.run(
+                    self._node_cmd("worker", f"worker-{w}", f"--id {w}"),
+                    capture=False,
+                )
+
+    def start_clients(self, rate: int, tx_size: int, faults: int = 0) -> None:
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from narwhal_tpu.config import WorkerCache
+
+        cache = WorkerCache.import_(f"{self.base}/workers.json")
+        lanes = [
+            info.transactions
+            for ws in cache.workers.values()
+            for info in ws.values()
+        ]
+        alive = self.conns[: len(self.conns) - faults]
+        share = max(1, rate // max(1, len(alive) * self.workers))
+        nodes = " ".join(lanes)
+        for i, conn in enumerate(alive):
+            cache_keys = list(cache.workers)
+            for w, info in cache.workers[cache_keys[i]].items():
+                conn.run(
+                    f"cd {REMOTE_DIR} && nohup python3 -m narwhal_tpu "
+                    f"benchmark_client --target {info.transactions} "
+                    f"--rate {share} --size {tx_size} --nodes {nodes} "
+                    f"< /dev/null > client-{w}.log 2>&1 &",
+                    capture=False,
+                )
+
+    def stop(self) -> None:
+        for conn in self.conns:
+            conn.run("pkill -f 'python3 -m narwhal_tpu' || true", check=False)
+
+    # -- 5. logs -----------------------------------------------------------
+    def collect_logs(self, faults: int = 0):
+        from .logs import LogParser
+
+        log_dir = os.path.join(self.base, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        for i, conn in enumerate(self.conns[: len(self.conns) - faults]):
+            conn.get(f"{REMOTE_DIR}/primary.log", f"{log_dir}/primary-{i}.log")
+            for w in range(self.workers):
+                conn.get(
+                    f"{REMOTE_DIR}/worker-{w}.log", f"{log_dir}/worker-{i}-{w}.log"
+                )
+                conn.get(f"{REMOTE_DIR}/client-{w}.log", f"{log_dir}/client-{i}{w}.log")
+        return LogParser.process(
+            log_dir, faults=faults, parameters=getattr(self, "node_parameters", None)
+        )
+
+    def wait_booted(self, faults: int = 0, timeout: float = 120.0) -> None:
+        """Poll every alive host's primary log for the boot line (the
+        reference harness' 'successfully booted' wait). Python startup in
+        some environments preloads heavyweight libraries, so a fixed sleep
+        is not enough when many nodes share cores."""
+        deadline = time.time() + timeout
+        alive = self.conns[: len(self.conns) - faults]
+        pending = list(alive)
+        while pending and time.time() < deadline:
+            still = []
+            for conn in pending:
+                out = conn.run(
+                    f"grep -c 'successfully booted' {REMOTE_DIR}/primary.log "
+                    f"{REMOTE_DIR}/worker-*.log 2>/dev/null | "
+                    f"awk -F: '{{s+=$2}} END {{print s}}'",
+                    check=False,
+                )
+                booted = int(out.stdout.strip() or 0)
+                if booted < 1 + self.workers:
+                    still.append(conn)
+            pending = still
+            if pending:
+                time.sleep(1.0)
+        if pending:
+            raise TimeoutError(
+                f"nodes never booted on: {[c.host for c in pending]}"
+            )
+
+    def run(self, rate: int, tx_size: int, duration: int, faults: int = 0):
+        self.stop()
+        self.start(faults=faults)
+        self.wait_booted(faults=faults)
+        self.start_clients(rate, tx_size, faults=faults)
+        time.sleep(duration)
+        self.stop()
+        return self.collect_logs(faults=faults)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.remote")
+    ap.add_argument("--hosts", required=True, help="file: one user@host per line")
+    ap.add_argument("--workers", type=int, default=1)
+    sub = ap.add_subparsers(dest="command", required=True)
+    sub.add_parser("install")
+    sub.add_parser("configure")
+    sub.add_parser("stop")
+    runp = sub.add_parser("run")
+    runp.add_argument("--rate", type=int, default=10_000)
+    runp.add_argument("--tx-size", type=int, default=512)
+    runp.add_argument("--duration", type=int, default=30)
+    runp.add_argument("--faults", type=int, default=0)
+    args = ap.parse_args()
+
+    with open(args.hosts) as f:
+        hosts = [line.strip() for line in f if line.strip()]
+    bench = RemoteBench(hosts, workers=args.workers)
+    if args.command == "install":
+        bench.install()
+    elif args.command == "configure":
+        bench.configure()
+    elif args.command == "stop":
+        bench.stop()
+    elif args.command == "run":
+        bench.configure()
+        parser = bench.run(args.rate, args.tx_size, args.duration, args.faults)
+        print(parser.result())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Saturation sweep: run the local bench across increasing input rates,
+find the throughput knee, and emit a machine-readable result set.
+
+The reference finds its knee by hand-editing fabfile parameters and re-running
+`fab local`; this automates it:
+
+    python -m benchmark.sweep --rates 5000 15000 30000 40000 --duration 20
+    python -m benchmark.sweep --auto --duration 20      # geometric auto-sweep
+
+Writes `.bench/sweep.json` (one record per run, LogParser.to_dict shape) and
+prints a markdown table. Plot with `python -m benchmark.plot .bench/sweep.json`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from .local import BenchParameters, LocalBench
+from .logs import ParseError
+
+
+def run_once(rate: int, args) -> dict:
+    bench = LocalBench(
+        BenchParameters(
+            nodes=args.nodes,
+            workers=args.workers,
+            rate=rate,
+            tx_size=args.tx_size,
+            duration=args.duration,
+            faults=args.faults,
+        )
+    )
+    parser = bench.run()
+    record = parser.to_dict()
+    print(
+        f"  rate {rate:>8,}: TPS {record['consensus_tps']:>10,.0f}  "
+        f"lat {record['consensus_latency_ms']:>8,.0f} ms  "
+        f"e2e {record['end_to_end_latency_ms']:>8,.0f} ms"
+    )
+    return record
+
+
+def sweep(args) -> list[dict]:
+    results: list[dict] = []
+    if args.auto:
+        # Geometric ramp until TPS stops improving by >10% (the knee).
+        rate = args.start_rate
+        best = 0.0
+        while True:
+            try:
+                record = run_once(rate, args)
+            except ParseError as e:
+                print(f"  rate {rate:,}: run failed ({e}); stopping sweep")
+                break
+            results.append(record)
+            tps = record["consensus_tps"]
+            if tps < best * 1.1:
+                break  # saturated: no meaningful gain from more input
+            best = max(best, tps)
+            rate *= 2
+    else:
+        for rate in args.rates:
+            try:
+                results.append(run_once(rate, args))
+            except ParseError as e:
+                print(f"  rate {rate:,}: run failed ({e})")
+    return results
+
+
+def render_table(results: list[dict]) -> str:
+    lines = [
+        "| input rate | consensus TPS | consensus lat | e2e lat |",
+        "|---|---|---|---|",
+    ]
+    for r in results:
+        lines.append(
+            f"| {r['input_rate']:,} | {r['consensus_tps']:,.0f} "
+            f"| {r['consensus_latency_ms']:,.0f} ms "
+            f"| {r['end_to_end_latency_ms']:,.0f} ms |"
+        )
+    if results:
+        knee = max(results, key=lambda r: r["consensus_tps"])
+        lines.append(
+            f"\nknee: ~{knee['consensus_tps']:,.0f} tx/s "
+            f"at input rate {knee['input_rate']:,}"
+        )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.sweep")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=20)
+    ap.add_argument("--faults", type=int, default=0)
+    ap.add_argument("--rates", type=int, nargs="*", default=[5_000, 15_000, 30_000])
+    ap.add_argument("--auto", action="store_true", help="geometric ramp to the knee")
+    ap.add_argument("--start-rate", type=int, default=2_000)
+    ap.add_argument("--out", default=".bench/sweep.json")
+    args = ap.parse_args()
+
+    results = sweep(args)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"\nwrote {len(results)} records to {args.out}\n")
+    print(render_table(results))
+
+
+if __name__ == "__main__":
+    main()

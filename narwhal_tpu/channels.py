@@ -19,6 +19,20 @@ T = TypeVar("T")
 DEFAULT_CHANNEL_CAPACITY = 1_000
 
 
+def metered_channel(registry, role: str, name: str, capacity: int) -> "Channel":
+    """A Channel with its depth gauge registered as
+    `<role>_channel_<name>_depth` (SURVEY §5.6: every inter-task channel is
+    a gauge; types/src/metered_channel.rs:15-259). The single naming seam
+    for node/primary/worker channel metrics."""
+    return Channel(
+        capacity,
+        gauge=registry.gauge(
+            f"{role}_channel_{name}_depth",
+            f"depth of the {role}'s {name} channel",
+        ),
+    )
+
+
 class Channel(Generic[T]):
     """Bounded mpsc with a depth gauge."""
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from .channels import Channel
+from .channels import Channel, metered_channel
 from .config import Committee, Parameters, WorkerCache
 from .consensus import Bullshark, Consensus, Dag, Tusk
 from .consensus.metrics import ConsensusMetrics
@@ -91,11 +91,15 @@ class PrimaryNode:
         self.registry = registry or Registry()
         self.internal_consensus = internal_consensus
 
-        # Channels between the three subsystems (node/src/lib.rs:150-192).
-        self.tx_new_certificates = Channel(10_000)
-        self.tx_committed_certificates = Channel(10_000)
-        self.tx_consensus_output = Channel(10_000)
-        self.tx_execution_output = Channel(10_000)
+        # Channels between the three subsystems (node/src/lib.rs:150-192),
+        # depth-gauged like the reference's porcelain metrics (lib.rs:168-192).
+        def chan(name: str, capacity: int) -> Channel:
+            return metered_channel(self.registry, "node", name, capacity)
+
+        self.tx_new_certificates = chan("new_certificates", 10_000)
+        self.tx_committed_certificates = chan("committed_certificates", 10_000)
+        self.tx_consensus_output = chan("consensus_output", 10_000)
+        self.tx_execution_output = chan("execution_output", 10_000)
 
         # Crypto backend (the --crypto-backend flag of SURVEY §7.8c):
         #   cpu  — inline host verification in the Core (reference behavior)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..channels import Channel, Watch
+from ..channels import Channel, Watch, metered_channel
 from ..config import Committee, Parameters, WorkerCache
 from ..crypto import SignatureService
 from ..messages import (
@@ -87,17 +87,22 @@ class Primary:
         )
         self._tasks: list[asyncio.Task] = []
 
-        # Channels (primary.rs:104-151).
-        self.tx_primary_messages = Channel(1_000)
-        self.tx_headers_loopback = Channel(1_000)
-        self.tx_certificates_loopback = Channel(1_000)
-        self.tx_sync_headers = Channel(1_000)  # SyncBatches | SyncParents
-        self.tx_sync_certificates = Channel(1_000)  # suspended certificates
-        self.tx_headers = Channel(1_000)  # proposer -> core
-        self.tx_parents = Channel(1_000)  # core -> proposer
-        self.tx_our_digests = Channel(10_000)  # workers -> proposer
-        self.tx_others_digests = Channel(10_000)  # workers -> payload receiver
-        self.tx_state_handler = Channel(100)
+        # Channels (primary.rs:104-151), each with a depth gauge — SURVEY
+        # §5.6 "every inter-task channel is a gauge"
+        # (types/src/metered_channel.rs:15-259, PrimaryChannelMetrics).
+        def chan(name: str, capacity: int) -> Channel:
+            return metered_channel(self.registry, "primary", name, capacity)
+
+        self.tx_primary_messages = chan("primary_messages", 1_000)
+        self.tx_headers_loopback = chan("headers_loopback", 1_000)
+        self.tx_certificates_loopback = chan("certificates_loopback", 1_000)
+        self.tx_sync_headers = chan("sync_headers", 1_000)  # SyncBatches|Parents
+        self.tx_sync_certificates = chan("sync_certificates", 1_000)  # suspended
+        self.tx_headers = chan("headers", 1_000)  # proposer -> core
+        self.tx_parents = chan("parents", 1_000)  # core -> proposer
+        self.tx_our_digests = chan("our_digests", 10_000)  # workers -> proposer
+        self.tx_others_digests = chan("others_digests", 10_000)  # -> payload recv
+        self.tx_state_handler = chan("state_handler", 100)
         self.tx_new_certificates = tx_new_certificates
         self.rx_committed_certificates = rx_committed_certificates
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..channels import Channel, Watch
+from ..channels import Channel, Watch, metered_channel
 from ..config import Committee, Parameters, WorkerCache
 from ..messages import (
     CleanupMsg,
@@ -99,13 +99,17 @@ class Worker:
         self.rx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
         self._tasks: list[asyncio.Task] = []
 
-        # Channels (worker/src/worker.rs:229-346 wiring).
-        self.tx_batch_maker = Channel(10_000)
-        self.tx_quorum_waiter = Channel(1_000)
-        self.tx_processor = Channel(1_000)
-        self.tx_others_processor = Channel(1_000)
-        self.tx_digest = Channel(10_000)
-        self.tx_sync_command = Channel(1_000)
+        # Channels (worker/src/worker.rs:229-346 wiring), depth-gauged
+        # (SURVEY §5.6; types/src/metered_channel.rs:15-259).
+        def chan(name: str, capacity: int) -> Channel:
+            return metered_channel(self.registry, "worker", name, capacity)
+
+        self.tx_batch_maker = chan("batch_maker", 10_000)
+        self.tx_quorum_waiter = chan("quorum_waiter", 1_000)
+        self.tx_processor = chan("processor", 1_000)
+        self.tx_others_processor = chan("others_processor", 1_000)
+        self.tx_digest = chan("digest", 10_000)
+        self.tx_sync_command = chan("sync_command", 1_000)
 
     async def spawn(self) -> None:
         me = self.worker_cache.worker(self.name, self.worker_id)

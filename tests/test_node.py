@@ -58,6 +58,19 @@ def test_cluster_commits_transactions_e2e(run):
             assert all(len(r) == 64 for r in results)
             assert results[0] == results[1] == results[2] == results[3]
             assert set(results[0]) == set(txs)
+
+            # §5.6 observability: every inter-task channel carries a depth
+            # gauge wired into the node registry (metered_channel.rs:15-259).
+            # Check REGISTRATION (render includes the metric's HELP/TYPE
+            # lines), not .value(), which returns 0.0 for unknown names.
+            rendered = cluster.authorities[0].primary.registry.render()
+            for gauge in (
+                "primary_channel_primary_messages_depth",
+                "primary_channel_our_digests_depth",
+                "node_channel_new_certificates_depth",
+                "node_channel_consensus_output_depth",
+            ):
+                assert gauge in rendered, f"{gauge} not registered"
         finally:
             client.close()
             await cluster.shutdown()

@@ -1,0 +1,57 @@
+"""Remote benchmark orchestration exercised end-to-end through
+LocalConnection: the full install -> configure -> start -> clients -> stop ->
+collect-logs flow runs against three simulated hosts on this machine, so the
+SSH command surface (the `fab remote` analog) is tested without sshd."""
+
+import os
+import shutil
+
+import pytest
+
+from benchmark.remote import LocalConnection, RemoteBench
+
+
+@pytest.mark.slow
+def test_remote_bench_flow_on_local_connections(tmp_path):
+    # Four simulated machines that all resolve to this one (distinct roots,
+    # distinct port blocks via the per-node offset in configure()).
+    hosts = [f"node{i}@127.0.0.1" for i in range(4)]
+    roots = {h: str(tmp_path / h.split("@")[0]) for h in hosts}
+
+    def factory(host):
+        return LocalConnection(host, roots[host])
+
+    bench = RemoteBench(
+        hosts,
+        workers=1,
+        base_port=0,  # 0 => give every node an ephemeral block below
+        connection_factory=factory,
+        work_dir=str(tmp_path / "ctl"),
+    )
+    # Ephemeral port blocks per node (the hosts share this machine).
+    from narwhal_tpu.config import get_available_port
+
+    bench.base_port = get_available_port()
+
+    try:
+        bench.install()
+        for host in hosts:
+            assert os.path.isdir(
+                os.path.join(roots[host], "narwhal-tpu", "narwhal_tpu")
+            ), f"install did not unpack on {host}"
+
+        cfg = bench.configure()
+        assert len(cfg["committee"].authorities) == 4
+        for i, host in enumerate(hosts):
+            key_path = os.path.join(roots[host], "narwhal-tpu", "configs", "key.json")
+            assert os.path.exists(key_path)
+
+        # Generous duration: every spawned interpreter pays this
+        # environment's heavyweight preload on a single shared core.
+        parser = bench.run(rate=800, tx_size=128, duration=20)
+        result = parser.result()
+        assert "Consensus TPS" in result
+        assert parser.to_dict()["consensus_tps"] > 0, result
+    finally:
+        bench.stop()
+        shutil.rmtree(str(tmp_path), ignore_errors=True)
