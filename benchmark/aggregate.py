@@ -50,6 +50,9 @@ def main() -> None:
             data = json.load(f)
         records.extend(data if isinstance(data, list) else [data])
     result = aggregate(records)
+    import os
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(f"aggregated {len(records)} runs into {len(result)} groups -> {args.out}")

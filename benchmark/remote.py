@@ -267,7 +267,9 @@ class RemoteBench:
                 conn.get(
                     f"{REMOTE_DIR}/worker-{w}.log", f"{log_dir}/worker-{i}-{w}.log"
                 )
-                conn.get(f"{REMOTE_DIR}/client-{w}.log", f"{log_dir}/client-{i}{w}.log")
+                conn.get(
+                    f"{REMOTE_DIR}/client-{w}.log", f"{log_dir}/client-{i}-{w}.log"
+                )
         return LogParser.process(
             log_dir, faults=faults, parameters=getattr(self, "node_parameters", None)
         )
@@ -302,6 +304,11 @@ class RemoteBench:
 
     def run(self, rate: int, tx_size: int, duration: int, faults: int = 0):
         self.stop()
+        # Fresh stores per run: configure() regenerates committee keys, so
+        # recovering state persisted under an old committee would wedge the
+        # nodes (LocalBench rmtree's its base dir for the same reason).
+        for conn in self.conns:
+            conn.run(f"rm -rf {REMOTE_DIR}/db-* {REMOTE_DIR}/*.log", check=False)
         self.start(faults=faults)
         self.wait_booted(faults=faults)
         self.start_clients(rate, tx_size, faults=faults)

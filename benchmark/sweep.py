@@ -56,6 +56,9 @@ def sweep(args) -> list[dict]:
                 break
             results.append(record)
             tps = record["consensus_tps"]
+            if tps <= 0:
+                print(f"  rate {rate:,}: no commits parsed; stopping sweep")
+                break
             if tps < best * 1.1:
                 break  # saturated: no meaningful gain from more input
             best = max(best, tps)

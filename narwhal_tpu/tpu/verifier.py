@@ -53,10 +53,14 @@ class TpuVerifier:
         for size in sizes or (_MIN_BUCKET, self.max_bucket):
             self([(kp.public, b"warmup", sig)] * size)
 
-    def __call__(self, items: Sequence[BatchItem]) -> list[bool]:
+    def submit(self, items: Sequence[BatchItem]):
+        """Pack + precheck on host and enqueue the device dispatch(es).
+        Returns an opaque handle for `collect` — dispatch is asynchronous, so
+        several submitted batches stay in flight and the device readback
+        latency overlaps the next batch's host packing and compute."""
         n = len(items)
         if n == 0:
-            return []
+            return (np.zeros(0, bool), np.zeros(0, np.int64), [])
         ok = np.zeros(n, bool)
         a_raw = np.zeros((n, 32), np.uint8)
         r_raw = np.zeros((n, 32), np.uint8)
@@ -85,7 +89,7 @@ class TpuVerifier:
 
         idx = np.flatnonzero(precheck)
         if idx.size == 0:
-            return ok.tolist()
+            return (ok, idx, [])
 
         a_y = self.kernel.bytes_to_limbs(a_raw[idx])
         r_y = self.kernel.bytes_to_limbs(r_raw[idx])
@@ -94,7 +98,7 @@ class TpuVerifier:
         k_digits = self.kernel.bytes_to_digits(k_raw[idx])
         s_digits = self.kernel.bytes_to_digits(s_raw[idx])
 
-        results = np.zeros(idx.size, bool)
+        outs = []  # (lo, hi, device array)
         for lo in range(0, idx.size, self.max_bucket):
             hi = min(lo + self.max_bucket, idx.size)
             bucket = _MIN_BUCKET
@@ -117,9 +121,22 @@ class TpuVerifier:
                 pad_to(k_digits),
                 pad_to(s_digits),
             )
-            results[lo:hi] = np.asarray(out)[: hi - lo]
-        ok[idx] = results
+            outs.append((lo, hi, out))
+        return (ok, idx, outs)
+
+    @staticmethod
+    def collect(handle) -> list[bool]:
+        """Materialize a `submit` handle's results (blocks on the device)."""
+        ok, idx, outs = handle
+        if idx.size:
+            results = np.zeros(idx.size, bool)
+            for lo, hi, out in outs:
+                results[lo:hi] = np.asarray(out)[: hi - lo]
+            ok[idx] = results
         return ok.tolist()
+
+    def __call__(self, items: Sequence[BatchItem]) -> list[bool]:
+        return self.collect(self.submit(items))
 
 
 def make_batch_verifier(fallback_on_error: bool = True):
