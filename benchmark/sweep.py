@@ -54,11 +54,11 @@ def sweep(args) -> list[dict]:
             except ParseError as e:
                 print(f"  rate {rate:,}: run failed ({e}); stopping sweep")
                 break
-            results.append(record)
             tps = record["consensus_tps"]
             if tps <= 0:
                 print(f"  rate {rate:,}: no commits parsed; stopping sweep")
                 break
+            results.append(record)
             if tps < best * 1.1:
                 break  # saturated: no meaningful gain from more input
             best = max(best, tps)

@@ -100,15 +100,13 @@ def _write_frame(
 ) -> None:
     # Two writes instead of one concatenated buffer: batch frames are large
     # (hundreds of KB) and the header+body copy showed up at high rates.
-    # On authenticated connections every frame carries a keyed MAC over
-    # (direction, sequence, header, body); seal+write happen without an
-    # await in between so the MAC sequence matches the wire order.
+    # On authenticated connections the body is AEAD-sealed (AES-GCM,
+    # counter nonce, header as AAD); seal+write happen without an await in
+    # between so the nonce sequence matches the wire order.
     if session is not None:
-        mac = session.seal(kind, rid, tag, body)
-        writer.write(_FRAME_HDR.pack(len(body) + MAC_LEN, kind, rid, tag))
-        if body:
-            writer.write(body)
-        writer.write(mac)
+        ct = session.seal_body(kind, rid, tag, body)
+        writer.write(_FRAME_HDR.pack(len(ct), kind, rid, tag))
+        writer.write(ct)
     else:
         writer.write(_FRAME_HDR.pack(len(body), kind, rid, tag))
         if body:
@@ -126,8 +124,7 @@ async def _read_frame(
     if session is not None:
         if length < MAC_LEN:
             raise RpcError("unauthenticated frame on authenticated connection")
-        body, mac = body[:-MAC_LEN], body[-MAC_LEN:]
-        session.open(kind, rid, tag, body, mac)  # raises AuthError on forgery
+        body = session.open_body(kind, rid, tag, body)  # AuthError on forgery
     return kind, rid, tag, body
 
 

@@ -138,35 +138,38 @@ def test_wrong_role_is_unauthorized(run):
     run(scenario())
 
 
-def test_session_mac_rejects_forged_and_replayed_frames():
-    """Post-handshake frames are MAC'd per direction with a sequence number:
-    a relay that forwarded the handshake verbatim still cannot inject,
-    tamper with, or replay frames (it never learns the X25519 shared
-    secret, so it cannot produce a valid tag)."""
+def test_session_aead_rejects_forged_and_replayed_frames():
+    """Post-handshake frames are AEAD-sealed per direction with a counter
+    nonce: a relay that forwarded the handshake verbatim still cannot read,
+    inject, tamper with, or replay frames (it never learns the X25519
+    shared secret, so it cannot produce a valid ciphertext)."""
+    import os
+
     import pytest
 
     from narwhal_tpu.network.auth import AuthError, Session
-
-    import os
 
     k_c2s, k_s2c = os.urandom(32), os.urandom(32)
     client = Session(send_key=k_c2s, recv_key=k_s2c)
     server = Session(send_key=k_s2c, recv_key=k_c2s)
 
     body = b"hello-frame"
-    mac = client.seal(0, 1, 7, body)
-    server.open(0, 1, 7, body, mac)  # legitimate frame passes
+    ct = client.seal_body(0, 1, 7, body)
+    assert body not in ct  # encrypted, not just authenticated
+    assert server.open_body(0, 1, 7, ct) == body  # legitimate frame passes
 
-    # Tampered body.
-    mac2 = client.seal(0, 2, 7, body)
+    ct2 = client.seal_body(0, 2, 7, body)
+    # Tampered ciphertext.
     with pytest.raises(AuthError):
-        server.open(0, 2, 7, b"evil-frame!", mac2)
-    # Injected frame with a guessed tag.
+        server.open_body(0, 2, 7, bytes([ct2[0] ^ 1]) + ct2[1:])
+    # Tampered header (AAD mismatch).
     with pytest.raises(AuthError):
-        server.open(0, 3, 7, b"inject", b"\x00" * 16)
-    # Replay of the first frame (stale sequence number).
+        server.open_body(0, 99, 7, ct2)
+    # Replay of the first frame (stale nonce).
     with pytest.raises(AuthError):
-        server.open(0, 1, 7, body, mac)
+        server.open_body(0, 1, 7, ct)
+    # The in-sequence original still decrypts after the failed attempts.
+    assert server.open_body(0, 2, 7, ct2) == body
 
 
 def test_authenticated_request_roundtrip_uses_macs(run):
