@@ -1,0 +1,142 @@
+"""Microbenchmarks: the criterion analogs.
+
+Reference: /root/reference/types/benches/batch_digest.rs:10-37 (digesting a
+serialized batch with vs without deserialization) and
+consensus/benches/process_certificates.rs:18-80 (Bullshark certificate
+processing over synthetic DAGs, with pprof flamegraphs).
+
+    python -m benchmark.microbench            # all, one JSON line each
+    python -m benchmark.microbench --profile  # + cProfile top functions
+
+For whole-node profiles, run any role (or the local bench) with
+NARWHAL_PROFILE=<dir>: every process dumps a cProfile .pstats on exit
+(`python -m pstats <file>` or snakeviz to inspect) — the dhat/pprof plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import json
+import pstats
+import time
+
+
+def bench_batch_digest() -> list[dict]:
+    """Serialized-batch digest vs decode-then-digest (batch_digest.rs)."""
+    from narwhal_tpu.types import Batch, serialized_batch_digest
+
+    batch = Batch(tuple(bytes([i % 256]) * 512 for i in range(1000)))
+    raw = batch.to_bytes()
+    out = []
+    for name, fn in (
+        ("serialized_batch_digest", lambda: serialized_batch_digest(raw)),
+        ("decode_then_digest", lambda: Batch.from_bytes(raw).digest),
+    ):
+        fn()
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 1.0:
+            fn()
+            n += 1
+        dt = (time.perf_counter() - t0) / n
+        out.append(
+            {
+                "metric": f"batch_digest_GBps[{name}]",
+                "value": round(len(raw) / dt / 1e9, 3),
+                "unit": "GB/s",
+                "batch_bytes": len(raw),
+            }
+        )
+    return out
+
+
+def bench_process_certificates(size: int = 20, rounds: int = 50) -> list[dict]:
+    """Bullshark + Tusk certificate processing over an optimal synthetic DAG
+    (process_certificates.rs shape)."""
+    from narwhal_tpu.consensus import Bullshark, ConsensusState, Tusk
+    from narwhal_tpu.fixtures import CommitteeFixture, make_optimal_certificates
+    from narwhal_tpu.stores import NodeStorage
+    from narwhal_tpu.types import Certificate
+
+    f = CommitteeFixture(size=size)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_optimal_certificates(f.committee, 1, rounds, genesis)
+    certs = list(certs)
+    out = []
+    for name, engine_cls in (("bullshark", Bullshark), ("tusk", Tusk)):
+        engine = engine_cls(f.committee, NodeStorage(None).consensus_store, 50)
+        state = ConsensusState(Certificate.genesis(f.committee))
+        index = 0
+        t0 = time.perf_counter()
+        for c in certs:
+            outp = engine.process_certificate(state, index, c)
+            index += len(outp)
+        dt = time.perf_counter() - t0
+        out.append(
+            {
+                "metric": f"process_certificates_per_s[{name}]",
+                "value": round(len(certs) / dt, 1),
+                "unit": "certs/s",
+                "committee": size,
+                "rounds": rounds,
+            }
+        )
+    return out
+
+
+def bench_codec() -> list[dict]:
+    """Message encode/decode throughput on a payload-bearing header."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.messages import HeaderMsg, Writer, decode_message, encode_message
+
+    f = CommitteeFixture(size=4)
+    payload = {bytes([i]) * 32: 0 for i in range(32)}
+    msg = HeaderMsg(f.header(author=0, round=1, payload=payload))
+    tag, body = encode_message(msg)
+
+    def encode_fresh():
+        w = Writer()
+        msg.encode(w)  # bypass the per-object memo: measure the real encoder
+        return w.finish()
+
+    out = []
+    for name, fn in (
+        ("encode", encode_fresh),
+        ("decode", lambda: decode_message(tag, body)),
+    ):
+        fn()
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < 0.5:
+            fn()
+            n += 1
+        dt = (time.perf_counter() - t0) / n
+        out.append(
+            {
+                "metric": f"header_codec_per_s[{name}]",
+                "value": round(1 / dt, 1),
+                "unit": "ops/s",
+                "wire_bytes": len(body),
+            }
+        )
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.microbench")
+    ap.add_argument("--profile", action="store_true", help="cProfile the consensus bench")
+    args = ap.parse_args()
+    for rec in bench_batch_digest() + bench_codec() + bench_process_certificates():
+        print(json.dumps(rec))
+    if args.profile:
+        prof = cProfile.Profile()
+        prof.enable()
+        bench_process_certificates()
+        prof.disable()
+        s = io.StringIO()
+        pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(15)
+        print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

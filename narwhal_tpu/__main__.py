@@ -101,6 +101,7 @@ async def _run_node(args) -> None:
             parameters,
             storage,
             internal_consensus=not args.consensus_disabled,
+            consensus_protocol=getattr(args, "consensus_protocol", "bullshark"),
             crypto_backend=getattr(args, "crypto_backend", "cpu"),
             dag_backend=getattr(args, "dag_backend", "cpu"),
             network_keypair=network_keypair,
@@ -186,6 +187,11 @@ def main(argv: list[str] | None = None) -> None:
         "--dag-backend", choices=("cpu", "tpu"), default="cpu",
         help="consensus commit walk: host order_dag (cpu) or the on-device "
         "adjacency-tensor kernels (tpu)",
+    )
+    p.add_argument(
+        "--consensus-protocol", choices=("bullshark", "tusk"), default="bullshark",
+        help="ordering engine (the reference's default is bullshark; tusk is "
+        "the asynchronous-network variant)",
     )
     w = rsub.add_parser("worker")
     w.add_argument("--id", type=int, required=True)

@@ -73,6 +73,7 @@ class Cluster:
         store_base: str | None = None,
         crypto_backend: str = "cpu",
         dag_backend: str = "cpu",
+        consensus_protocol: str = "bullshark",
     ):
         self.fixture = CommitteeFixture(size=size, workers=workers)
         self.parameters = parameters or replace(
@@ -83,6 +84,7 @@ class Cluster:
         self.store_base = store_base
         self.crypto_backend = crypto_backend
         self.dag_backend = dag_backend
+        self.consensus_protocol = consensus_protocol
         # Pre-assign real ports so no early broadcast targets a placeholder.
         committee = self.fixture.committee
         for pk, auth in committee.authorities.items():
@@ -120,6 +122,7 @@ class Cluster:
             self.parameters,
             storage,
             internal_consensus=self.internal_consensus,
+            consensus_protocol=self.consensus_protocol,
             crypto_backend=self.crypto_backend,
             dag_backend=self.dag_backend,
             network_keypair=fixture_auth.network_keypair,

@@ -141,14 +141,12 @@ class PrimaryNode:
             # adjacency-tensor kernels (SURVEY §7.8c; the reference's
             # consensus/src/utils.rs:11-101 hot loop, vectorized).
             if dag_backend == "tpu":
-                if consensus_protocol != "bullshark":
-                    raise ValueError(
-                        "dag_backend='tpu' implements the bullshark commit "
-                        "rule (TpuBullshark); use consensus_protocol='bullshark'"
-                    )
-                from .tpu.dag_kernels import TpuBullshark
+                from .tpu.dag_kernels import TpuBullshark, TpuTusk
 
-                protocol = TpuBullshark(
+                protocol_cls = {"bullshark": TpuBullshark, "tusk": TpuTusk}[
+                    consensus_protocol
+                ]
+                protocol = protocol_cls(
                     committee, storage.consensus_store, parameters.gc_depth
                 )
             else:

@@ -309,6 +309,16 @@ class TpuBullshark:
         masks = await loop.run_in_executor(None, np.asarray, masks_dev)
         return self._materialize(state, consensus_index, masks, K)
 
+    def _commit_coords(self, round: Round) -> tuple[Round, Round] | None:
+        """Bullshark rule (bullshark.rs:47-82): on a round-r+1 certificate
+        the candidate leader sits at even round r, supported by round r+1.
+        Returns (leader_round, support_round) or None when `round` cannot
+        trigger a commit."""
+        r = round - 1
+        if r % 2 != 0 or r < 2:
+            return None
+        return r, round
+
     def _ingest_and_dispatch(self, state: ConsensusState, certificate: Certificate):
         """Shared pre-readback half of process_certificate: record the
         certificate, evaluate the commit rule on the host mirror, and — when
@@ -321,21 +331,26 @@ class TpuBullshark:
             raise RuntimeError(
                 f"round {round} outside DAG window (base {self.win.round_base}, W {self.win.W})"
             )
-        r = round - 1
-        if r % 2 != 0 or r < 2 or r <= state.last_committed_round:
+        coords = self._commit_coords(round)
+        if coords is None:
             return None
-        leader_idx = self._leader_index(r, state.dag)
+        leader_round, support_round = coords
+        if leader_round <= state.last_committed_round:
+            return None
+        leader_idx = self._leader_index(leader_round, state.dag)
         if leader_idx is None:
             return None
-        return self._dispatch_commit(state, round, r, leader_idx)
+        return self._dispatch_commit(state, leader_round, support_round, leader_idx)
 
-    def _dispatch_commit(self, state, round, r, leader_idx):
+    def _dispatch_commit(self, state, r, support_round, leader_idx):
         """Quorum pre-check + chain detection on the host mirror (cheap
         bookkeeping), then ONE fused device dispatch for every flatten walk
-        of the commit event. Returns (device masks, chain length) or None."""
+        of the commit event. `r` is the leader's round; support is counted
+        among `support_round` certificates linking it. Returns (device
+        masks, chain length) or None."""
         # Support quorum pre-check (one column read): a device readback costs
         # a full round trip, so dispatch only when this certificate commits.
-        off_r = self.win._off(round)
+        off_r = self.win._off(support_round)
         voters = self.win.parent[off_r, :, leader_idx].astype(bool) & self.win.present[
             off_r
         ].astype(bool)
@@ -406,3 +421,17 @@ class TpuBullshark:
     def update_committee(self, new_committee: Committee) -> None:
         self.committee = new_committee
         self.win = DagWindow(new_committee, self.win.W)
+
+
+class TpuTusk(TpuBullshark):
+    """Tusk with the DAG walks on device: identical machinery to
+    TpuBullshark, the asynchronous commit rule (tusk.rs:47-82): a round-r
+    certificate (r-1 even, r-1 >= 4) makes the leader at round r-3 a commit
+    candidate, supported by its children at round r-2 carrying >= f+1
+    stake. Drop-in for consensus.Tusk."""
+
+    def _commit_coords(self, round: Round) -> tuple[Round, Round] | None:
+        r = round - 1
+        if r % 2 != 0 or r < 4:
+            return None
+        return r - 2, r - 1

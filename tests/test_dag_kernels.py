@@ -19,7 +19,10 @@ from tests.test_consensus import fixed_leader
 GC = 50
 
 
-def _run_both(size, rounds, failure, seed, gc=GC, leader_fn=fixed_leader, window=None):
+def _run_both(
+    size, rounds, failure, seed, gc=GC, leader_fn=fixed_leader, window=None,
+    host_cls=Bullshark, dev_cls=TpuBullshark,
+):
     f = CommitteeFixture(size=size)
     genesis = {c.digest for c in Certificate.genesis(f.committee)}
     certs, _ = make_certificates(
@@ -28,9 +31,9 @@ def _run_both(size, rounds, failure, seed, gc=GC, leader_fn=fixed_leader, window
     )
     host_state = ConsensusState(Certificate.genesis(f.committee))
     tpu_state = ConsensusState(Certificate.genesis(f.committee))
-    host = Bullshark(f.committee, NodeStorage(None).consensus_store, gc, leader_fn=leader_fn)
-    dev = TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc,
-                       leader_fn=leader_fn, window=window)
+    host = host_cls(f.committee, NodeStorage(None).consensus_store, gc, leader_fn=leader_fn)
+    dev = dev_cls(f.committee, NodeStorage(None).consensus_store, gc,
+                  leader_fn=leader_fn, window=window)
     host_seq, dev_seq = [], []
     hi = di = 0
     for c in certs:
@@ -71,6 +74,26 @@ def test_equivalence_small_window_slides():
     # Window smaller than the run length forces sliding + GC drops.
     seq = _run_both(size=4, rounds=60, failure=0.0, seed=0, gc=10, window=24)
     assert len(seq) > 200
+
+
+def test_equivalence_tusk_optimal_and_lossy():
+    """TpuTusk reproduces the host Tusk engine bit-for-bit (the asynchronous
+    commit rule: leader two rounds below the wait round)."""
+    from narwhal_tpu.consensus import Tusk
+    from narwhal_tpu.tpu.dag_kernels import TpuTusk
+
+    seq = _run_both(
+        size=4, rounds=14, failure=0.0, seed=0, host_cls=Tusk, dev_cls=TpuTusk
+    )
+    assert len(seq) > 20
+    for seed in range(3):
+        _run_both(
+            size=4, rounds=25, failure=0.3, seed=seed, host_cls=Tusk, dev_cls=TpuTusk
+        )
+    _run_both(
+        size=7, rounds=20, failure=0.15, seed=2,
+        leader_fn=None, host_cls=Tusk, dev_cls=TpuTusk,
+    )
 
 
 def test_window_grows_when_no_commits():

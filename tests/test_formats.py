@@ -44,3 +44,94 @@ def test_certificate_format_snapshot():
     cert = F.certificate(F.header(author=0, round=1))
     rt = Certificate.from_bytes(cert.to_bytes())
     assert rt == cert
+
+
+def _golden_messages():
+    """One deterministic instance of EVERY registered message (the full
+    generate_format surface, node/src/generate_format.rs): changing any
+    encoding — or forgetting to extend this table when adding a message —
+    fails the snapshot test below."""
+    from narwhal_tpu import messages as M
+
+    d1, d2 = b"\x11" * 32, b"\x22" * 32
+    pk = F.authorities[0].public
+    header = F.header(author=0, round=1)
+    vote = F.votes(header)[0]
+    cert = F.certificate(header)
+    return {
+        M.Ack: M.Ack(),
+        M.HeaderMsg: M.HeaderMsg(header),
+        M.VoteMsg: M.VoteMsg(vote),
+        M.CertificateMsg: M.CertificateMsg(cert),
+        M.CertificatesRequest: M.CertificatesRequest((d1, d2), pk),
+        M.CertificatesBatchRequest: M.CertificatesBatchRequest((d1,), pk),
+        M.CertificatesBatchResponse: M.CertificatesBatchResponse(
+            ((d1, None), (cert.digest, cert))
+        ),
+        M.CertificatesRangeRequest: M.CertificatesRangeRequest(1, 9, pk),
+        M.CertificatesRangeResponse: M.CertificatesRangeResponse((d1, d2)),
+        M.PayloadAvailabilityRequest: M.PayloadAvailabilityRequest((d1,), pk),
+        M.PayloadAvailabilityResponse: M.PayloadAvailabilityResponse(
+            ((d1, True), (d2, False))
+        ),
+        M.SynchronizeMsg: M.SynchronizeMsg((d1,), pk),
+        M.CleanupMsg: M.CleanupMsg(7),
+        M.RequestBatchMsg: M.RequestBatchMsg(d1),
+        M.DeleteBatchesMsg: M.DeleteBatchesMsg((d1, d2)),
+        M.ReconfigureMsg: M.ReconfigureMsg("new_epoch", "{}"),
+        M.OurBatchMsg: M.OurBatchMsg(d1, 0),
+        M.OthersBatchMsg: M.OthersBatchMsg(d2, 1),
+        M.RequestedBatchMsg: M.RequestedBatchMsg(d1, b"\x33" * 8, True),
+        M.DeletedBatchesMsg: M.DeletedBatchesMsg((d1,)),
+        M.WorkerErrorMsg: M.WorkerErrorMsg("boom"),
+        M.WorkerBatchMsg: M.WorkerBatchMsg(Batch((b"tx",)).to_bytes()),
+        M.WorkerBatchRequest: M.WorkerBatchRequest((d1,)),
+        M.WorkerBatchResponse: M.WorkerBatchResponse((Batch((b"tx",)).to_bytes(),)),
+        M.SubmitTransactionMsg: M.SubmitTransactionMsg(b"payload"),
+        M.SubmitTransactionStreamMsg: M.SubmitTransactionStreamMsg((b"a", b"bb")),
+        M.GetCollectionsRequest: M.GetCollectionsRequest((d1,)),
+        M.GetCollectionsResponse: M.GetCollectionsResponse(
+            ((d1, ((d2, (b"t1", b"t2")),), ""),)
+        ),
+        M.RemoveCollectionsRequest: M.RemoveCollectionsRequest((d1,)),
+        M.ReadCausalRequest: M.ReadCausalRequest(d1),
+        M.ReadCausalResponse: M.ReadCausalResponse((d1, d2)),
+        M.RoundsRequest: M.RoundsRequest(pk),
+        M.RoundsResponse: M.RoundsResponse(2, 11),
+        M.NodeReadCausalRequest: M.NodeReadCausalRequest(pk, 4),
+        M.NewNetworkInfoRequest: M.NewNetworkInfoRequest(0, ((pk, 1, "h:1"),)),
+        M.GetPrimaryAddressRequest: M.GetPrimaryAddressRequest(),
+        M.GetPrimaryAddressResponse: M.GetPrimaryAddressResponse("h:1"),
+        M.NewEpochRequest: M.NewEpochRequest(1),
+    }
+
+
+def test_full_registry_format_snapshot():
+    """Golden wire bytes for every message tag (tests/snapshots/messages.json).
+    Regenerate deliberately with REGEN_SNAPSHOTS=1 and review the diff."""
+    import hashlib
+    import json
+    import os
+
+    from narwhal_tpu.messages import REGISTRY, encode_message
+
+    goldens = _golden_messages()
+    missing = [cls.__name__ for cls in REGISTRY.values() if cls not in goldens]
+    assert not missing, f"no golden instance for: {missing}"
+
+    snap_path = os.path.join(os.path.dirname(__file__), "snapshots", "messages.json")
+    current = {}
+    for cls, msg in sorted(goldens.items(), key=lambda kv: kv[0].TAG):
+        tag, body = encode_message(msg)
+        current[f"{tag}:{cls.__name__}"] = hashlib.sha256(body).hexdigest()
+
+    if os.environ.get("REGEN_SNAPSHOTS"):
+        os.makedirs(os.path.dirname(snap_path), exist_ok=True)
+        with open(snap_path, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+    with open(snap_path) as f:
+        golden = json.load(f)
+    assert current == golden, (
+        "wire format drift; regenerate with REGEN_SNAPSHOTS=1 only if the "
+        "change is intentional"
+    )
