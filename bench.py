@@ -61,21 +61,70 @@ def main() -> None:
     # This is how the node's AsyncVerifierPool drives the chip under load.
     from concurrent.futures import ThreadPoolExecutor
 
+    # The tunneled device's round-trip latency drifts minute to minute, so a
+    # single window can under- or over-state the chip by 30%+. Measure
+    # several sustained windows and report the MEDIAN window throughput.
     depth = 3
-    rounds = ROUNDS * 2
+    window = 4  # batches per measurement window
+    windows = 5  # odd: rates[len//2] is the true median window
     with ThreadPoolExecutor(max_workers=1) as pool:
         futures = [pool.submit(verifier.submit, items) for _ in range(depth)]
-        t0 = time.perf_counter()
-        done = 0
-        for _ in range(rounds):
-            out = verifier.collect(futures.pop(0).result())
-            assert all(out)
-            done += BATCH
-            futures.append(pool.submit(verifier.submit, items))
-        tpu_dt = (time.perf_counter() - t0) / done * BATCH
+        rates = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(window):
+                out = verifier.collect(futures.pop(0).result())
+                assert all(out)
+                futures.append(pool.submit(verifier.submit, items))
+            rates.append(window * BATCH / (time.perf_counter() - t0))
         for f in futures:
             verifier.collect(f.result())
-    tpu_rate = BATCH / tpu_dt
+    rates.sort()
+    tpu_rate = rates[len(rates) // 2]
+
+    # Device-only rate via an on-device iteration chain (two-point
+    # differencing cancels the flat link latency): the chip's stable
+    # capability, independent of the host link's minute-to-minute bandwidth
+    # drift that the pipelined end-to-end number is exposed to.
+    import jax.numpy as jnp
+    from jax import lax
+
+    from narwhal_tpu.tpu import ed25519 as kern
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    dev_b = 8192
+    a_y = jnp.asarray(rng.integers(0, 1 << 13, (dev_b, 20), dtype=np.int32))
+    sign = jnp.zeros((dev_b,), jnp.int32)
+    dig = jnp.asarray(rng.integers(0, 16, (dev_b, 64), dtype=np.int32))
+
+    def repeat_kernel(reps):
+        @jax.jit
+        def f(a_y, sign, dig):
+            def body(i, acc):
+                ok = kern.verify_batch_kernel(a_y, sign, a_y, sign, dig + (i & 1), dig)
+                return acc + jnp.sum(ok.astype(jnp.int32))
+            return lax.fori_loop(0, reps, body, jnp.int32(0))
+        return f
+
+    def timed(fn, *args, iters=3):
+        ts = []
+        int(fn(*args))  # warm/compile
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            int(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    device_rate = None
+    for spread in (10, 30):  # widen the spread if link noise swamps the delta
+        t_small = timed(repeat_kernel(2), a_y, sign, dig)
+        t_big = timed(repeat_kernel(2 + spread), a_y, sign, dig)
+        delta = t_big - t_small
+        if delta > 0.25 * spread * 0.18:  # sanity: >= 25% of expected compute
+            device_rate = spread * dev_b / delta
+            break
 
     print(
         json.dumps(
@@ -84,6 +133,14 @@ def main() -> None:
                 "value": round(tpu_rate, 1),
                 "unit": "verifies/s",
                 "vs_baseline": round(tpu_rate / host_rate, 3),
+                "device_only_per_s": round(device_rate, 1) if device_rate else None,
+                "device_only_vs_baseline": (
+                    round(device_rate / host_rate, 3) if device_rate else None
+                ),
+                "host_per_s": round(host_rate, 1),
+                "note": "value = median pipelined e2e window incl. host packing "
+                "and tunneled transfers (link bandwidth drifts run to run); "
+                "device_only = stable on-chip rate at batch 8192",
             }
         )
     )

@@ -323,33 +323,45 @@ def decompress(y_limbs, sign):
 
 
 def _select(table, digit):
-    """table [16, NLIMB, B], digit [B] -> [NLIMB, B] (per-lane row select)."""
-    onehot = (digit[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(
-        jnp.int32
-    )  # [16, B]
-    return jnp.einsum("tlb,tb->lb", table, onehot)
+    """table [16, NLIMB, B], digit [B] -> [NLIMB, B]: binary where-tree on
+    the digit bits — (8+4+2+1) masked rows instead of the one-hot einsum's
+    16 multiply-accumulate rows (~2x fewer lane ops per lookup)."""
+    cur = table
+    for bit in (3, 2, 1, 0):
+        half = cur.shape[0] // 2
+        take_hi = ((digit >> bit) & 1).astype(bool)[None, None, :]
+        cur = jnp.where(take_hi, cur[half:], cur[:half])
+    return cur[0]
 
 
 def _select_const(table, digit):
     """table [16, NLIMB] (host constant), digit [B] -> [NLIMB, B]."""
-    onehot = (digit[None, :] == jnp.arange(16, dtype=jnp.int32)[:, None]).astype(
-        jnp.int32
+    cur = jnp.broadcast_to(
+        jnp.asarray(table)[:, :, None], (16, table.shape[1], digit.shape[0])
     )
-    return jnp.einsum("tl,tb->lb", jnp.asarray(table), onehot)
+    for bit in (3, 2, 1, 0):
+        half = cur.shape[0] // 2
+        take_hi = ((digit >> bit) & 1).astype(bool)[None, None, :]
+        cur = jnp.where(take_hi, cur[half:], cur[:half])
+    return cur[0]
 
 
 @jax.jit
 def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
     """Cofactorless check per lane: encode([S]B + [k](-A)) == (r_y, r_sign).
 
-    Host-facing shapes (batch-leading): a_y/r_y int32[B, NLIMB] canonical y
-    limbs; a_sign/r_sign int32[B]; k_digits/s_digits int32[B, 64] 4-bit
-    digits MSB-first. Returns bool[B].
+    Host-facing shapes (batch-leading): a_y/r_y int[B, NLIMB] canonical y
+    limbs; a_sign/r_sign int[B]; k_digits/s_digits int[B, 64] 4-bit digits
+    MSB-first. Narrow dtypes welcome — limbs fit int16 and digits int8, so
+    the host sends ~3x fewer bytes over the device link; everything is
+    widened to int32 lanes here. Returns bool[B].
     """
-    a_y = a_y.T  # -> limb-major [NLIMB, B]
-    r_y = r_y.T
-    k_digits = k_digits.T  # -> [64, B]
-    s_digits = s_digits.T
+    a_y = a_y.T.astype(jnp.int32)  # -> limb-major [NLIMB, B]
+    r_y = r_y.T.astype(jnp.int32)
+    a_sign = a_sign.astype(jnp.int32)
+    r_sign = r_sign.astype(jnp.int32)
+    k_digits = k_digits.T.astype(jnp.int32)  # -> [64, B]
+    s_digits = s_digits.T.astype(jnp.int32)
     B = a_y.shape[1]
 
     a_point, valid = decompress(a_y, a_sign)

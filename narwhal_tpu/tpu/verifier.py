@@ -91,12 +91,14 @@ class TpuVerifier:
         if idx.size == 0:
             return (ok, idx, [])
 
-        a_y = self.kernel.bytes_to_limbs(a_raw[idx])
-        r_y = self.kernel.bytes_to_limbs(r_raw[idx])
-        a_sign = (a_raw[idx, 31] >> 7).astype(np.int32)
-        r_sign = (r_raw[idx, 31] >> 7).astype(np.int32)
-        k_digits = self.kernel.bytes_to_digits(k_raw[idx])
-        s_digits = self.kernel.bytes_to_digits(s_raw[idx])
+        # Narrow upload dtypes (limbs < 2^13, digits < 16): ~3x fewer bytes
+        # over the device link; the kernel widens to int32 lanes on device.
+        a_y = self.kernel.bytes_to_limbs(a_raw[idx]).astype(np.int16)
+        r_y = self.kernel.bytes_to_limbs(r_raw[idx]).astype(np.int16)
+        a_sign = (a_raw[idx, 31] >> 7).astype(np.int8)
+        r_sign = (r_raw[idx, 31] >> 7).astype(np.int8)
+        k_digits = self.kernel.bytes_to_digits(k_raw[idx]).astype(np.int8)
+        s_digits = self.kernel.bytes_to_digits(s_raw[idx]).astype(np.int8)
 
         outs = []  # (lo, hi, device array)
         for lo in range(0, idx.size, self.max_bucket):
@@ -121,6 +123,13 @@ class TpuVerifier:
                 pad_to(k_digits),
                 pad_to(s_digits),
             )
+            # Kick off the device->host copy as soon as the kernel finishes
+            # so collect() finds the bytes already local instead of paying
+            # the transfer round trip synchronously.
+            try:
+                out.copy_to_host_async()
+            except AttributeError:
+                pass
             outs.append((lo, hi, out))
         return (ok, idx, outs)
 
