@@ -393,6 +393,12 @@ class TpuBullshark:
             jnp.asarray(offs),
             jnp.asarray(onehots),
         )
+        # Start the device->host copy as soon as the walk finishes so the
+        # materialization readback finds the masks already local.
+        try:
+            masks_dev.copy_to_host_async()
+        except AttributeError:
+            pass
         return masks_dev, K
 
     def _materialize(

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# ASan/UBSan job for the native storage engine (SURVEY §5.3). Builds the
+# engine together with its self-test under sanitizers and runs the full
+# exercise (CRUD, compaction, reopen recovery, torn-tail sweep).
+set -euo pipefail
+cd "$(dirname "$0")"
+out=$(mktemp -d)
+trap 'rm -rf "$out"' EXIT
+g++ -O1 -g -std=c++17 -fsanitize=address,undefined -fno-omit-frame-pointer \
+    -o "$out/engine_selftest" engine_selftest.cpp storage_engine.cpp -lz
+"$out/engine_selftest" "$out"
+echo "sanitizers clean"

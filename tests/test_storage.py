@@ -230,3 +230,26 @@ class TestNativeEngine:
         r = StorageEngine(path, use_native=True)
         assert r.column_family("cf").get(b"good") == b"data"
         r.close()
+
+
+def test_native_engine_sanitizers():
+    """ASan/UBSan job for the C++ engine (SURVEY §5.3): full CRUD +
+    compaction + reopen recovery + torn-tail sweep under sanitizers."""
+    import shutil
+    import subprocess
+    import os
+
+    import pytest
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in environment")
+    script = os.path.join(
+        os.path.dirname(__file__), "..", "native", "sanitize.sh"
+    )
+    if not os.path.exists(script):
+        pytest.skip("native/sanitize.sh not present")
+    proc = subprocess.run(
+        ["bash", script], capture_output=True, text=True, timeout=240
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "sanitizers clean" in proc.stdout
