@@ -93,6 +93,47 @@ def run(size: int, rounds: int, gc: int) -> None:
     finally:
         dk.chain_commit = orig
 
+    # Pure device time of one chain_commit at this (W, N) shape, measured
+    # with an on-device iteration chain + two-point differencing (the only
+    # trustworthy method through the tunnel, whose flat dispatch/readback
+    # latency otherwise dominates: see README "tunnel constraint").
+    import jax.numpy as jnp
+    from jax import lax
+
+    win = dev.win
+    parent_j = jnp.asarray(win.parent)
+    present_j = jnp.asarray(win.present)
+    lc = jnp.zeros((win.N,), jnp.int32)
+    offs_j = jnp.zeros((1,), jnp.int32).at[0].set(win.W - 2)
+    onehots_j = jnp.zeros((1, win.N), jnp.uint8).at[0, 0].set(1)
+
+    def chained(reps):
+        @jax.jit
+        def f(parent, present, lc, offs, onehots):
+            def body(i, acc):
+                masks = dk.chain_commit(
+                    parent, present, jnp.int32(gc), lc, jnp.int32(0), offs,
+                    jnp.roll(onehots, i, axis=1),
+                )
+                return acc + jnp.sum(masks.astype(jnp.int32))
+            return lax.fori_loop(0, reps, body, jnp.int32(0))
+        return f
+
+    def timed(fn, iters=3):
+        ts = []
+        int(fn(parent_j, present_j, lc, offs_j, onehots_j))
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            int(fn(parent_j, present_j, lc, offs_j, onehots_j))
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    # The walk is microseconds on device; thousands of chained reps are
+    # needed for the delta to clear the tunnel's timing noise.
+    t_small = timed(chained(2))
+    t_big = timed(chained(4002))
+    device_chain_ms = max(t_big - t_small, 0.0) / 4000 * 1000
+
     # Host per-event walk time for comparison: total host stream time is
     # dominated by the flatten (state bookkeeping is shared by both engines).
     n = len(certs)
@@ -121,6 +162,11 @@ def run(size: int, rounds: int, gc: int) -> None:
         {
             "metric": "commit_event_ms[tpu_readback]",
             "value": round(events["readback"] / n_events * 1000, 2),
+            "unit": "ms/event",
+        },
+        {
+            "metric": "commit_event_ms[tpu_device_chain]",
+            "value": round(device_chain_ms, 3),
             "unit": "ms/event",
         },
     ]
