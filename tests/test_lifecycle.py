@@ -156,3 +156,32 @@ def test_causal_completion_after_disk_restart(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=120.0)
+
+
+def test_larger_committee_with_two_faults(run):
+    """Seven validators (f=2): the committee commits, then keeps committing
+    with two nodes stopped — quorum math beyond the 4-node default
+    (SURVEY §2.14 scale-out by committee)."""
+
+    async def scenario():
+        cluster = Cluster(size=7, workers=1)
+        await cluster.start()
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=60.0)
+            await cluster.stop_node(6)
+            await cluster.stop_node(5)
+            # Baseline AFTER the faults land, so the +2 requirement can only
+            # be satisfied by genuinely post-fault commits.
+            before = max(
+                a.metric("consensus_last_committed_round")
+                for a in cluster.authorities
+                if a.primary is not None
+            )
+            rounds = await cluster.assert_progress(
+                expected_nodes=5, commit_threshold=int(before) + 2, timeout=60.0
+            )
+            assert len(rounds) == 5
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=150.0)
