@@ -51,7 +51,7 @@ def test_remote_bench_flow_on_local_connections(tmp_path):
         # test verifies ORCHESTRATION (install/configure/start/logs), so one
         # retry with a longer window absorbs transient host contention.
         parser = bench.run(rate=800, tx_size=128, duration=20)
-        if parser.to_dict()["consensus_tps"] <= 0:
+        if parser.consensus_throughput()[0] <= 0:
             parser = bench.run(rate=800, tx_size=128, duration=35)
         result = parser.result()
         assert "Consensus TPS" in result
