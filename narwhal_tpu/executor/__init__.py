@@ -15,6 +15,7 @@ from ..config import WorkerCache
 from ..network import NetworkClient
 from ..stores import CertificateStore, ConsensusStore, NodeStorage
 from ..types import ConsensusOutput, PublicKey
+from .metrics import ExecutorMetrics
 from .core import (
     ClientExecutionError,
     ExecutionState,
@@ -66,7 +67,9 @@ class Executor:
         network: NetworkClient,
         rx_consensus: Channel,
         tx_output: Channel | None = None,
+        registry=None,
     ):
+        metrics = ExecutorMetrics(registry) if registry is not None else None
         self.tx_executor = Channel(1_000)
         self.subscriber = Subscriber(
             name,
@@ -77,7 +80,11 @@ class Executor:
             self.tx_executor,
         )
         self.core = ExecutorCore(
-            execution_state, storage.temp_batch_store, self.tx_executor, tx_output
+            execution_state,
+            storage.temp_batch_store,
+            self.tx_executor,
+            tx_output,
+            metrics=metrics,
         )
         self._tasks: list[asyncio.Task] = []
 

@@ -56,7 +56,9 @@ class ExecutorCore:
         temp_batch_store: BatchStore,
         rx_subscriber: Channel,  # staged ConsensusOutput
         tx_output: Channel | None = None,  # (outcome, transaction) to the app
+        metrics=None,  # ExecutorMetrics (repo-specific progress counters)
     ):
+        self.metrics = metrics
         self.execution_state = execution_state
         self.temp_batch_store = temp_batch_store
         self.rx_subscriber = rx_subscriber
@@ -110,6 +112,8 @@ class ExecutorCore:
             self.execution_indices = ExecutionIndices(
                 next_certificate_index=self.execution_indices.next_certificate_index + 1
             )
+        if self.metrics is not None:
+            self.metrics.executed_certificates.inc()
         self.temp_batch_store.delete_all(d for d, _ in payload)
 
     async def _execute_batch(
@@ -126,6 +130,8 @@ class ExecutorCore:
                 )
                 if self.tx_output is not None:
                     await self.tx_output.send((result, transaction))
+                if self.metrics is not None:
+                    self.metrics.executed_transactions.inc()
             except ClientExecutionError as e:
                 logger.debug("skipping bad transaction: %s", e)
             self.execution_indices = next_indices

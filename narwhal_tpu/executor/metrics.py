@@ -1,0 +1,21 @@
+"""Executor progress counters surfaced on the node registry.
+
+The reference's executor/src/metrics.rs carries only channel-depth gauges
+(covered here by the node's metered channels); these applied-work counters
+are a repo-specific addition for operator dashboards and tests."""
+
+from __future__ import annotations
+
+from ..metrics import Registry
+
+
+class ExecutorMetrics:
+    def __init__(self, registry: Registry):
+        self.executed_transactions = registry.counter(
+            "executor_executed_transactions",
+            "Transactions applied to the execution state",
+        )
+        self.executed_certificates = registry.counter(
+            "executor_executed_certificates",
+            "Certificates whose payload finished executing",
+        )

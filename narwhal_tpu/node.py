@@ -176,6 +176,7 @@ class PrimaryNode:
                 self.primary.network,
                 self.tx_consensus_output,
                 self.tx_execution_output,
+                registry=self.registry,
             )
         else:
             # External consensus: the Dag service consumes the certificate

@@ -108,6 +108,26 @@ def test_grpc_end_to_end(run):
             except grpc.aio.AioRpcError as e:
                 assert e.code() == grpc.StatusCode.UNIMPLEMENTED
 
+            # NewNetworkInfo: full-coverage address update for the current
+            # epoch succeeds; a wrong epoch is INVALID_ARGUMENT.
+            nni = _unary(chan, "Configuration", "NewNetworkInfo", pb.Empty)
+            validators = [
+                pb.ValidatorData(
+                    public_key=p_,
+                    stake_weight=a.stake,
+                    primary_address=a.primary_address,
+                )
+                for p_, a in cluster.committee.authorities.items()
+            ]
+            await nni(pb.NewNetworkInfoRequest(epoch_number=0, validators=validators))
+            try:
+                await nni(
+                    pb.NewNetworkInfoRequest(epoch_number=9, validators=validators)
+                )
+                raise AssertionError("wrong epoch must be rejected")
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+
             # 5. Validator.RemoveCollections expunges the collection.
             rm = _unary(chan, "Validator", "RemoveCollections", pb.Empty)
             await rm(pb.CollectionRequest(collection_ids=[start]))

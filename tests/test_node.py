@@ -71,6 +71,9 @@ def test_cluster_commits_transactions_e2e(run):
                 "node_channel_consensus_output_depth",
             ):
                 assert gauge in rendered, f"{gauge} not registered"
+            # Executor progress counters (executor/src/metrics.rs parity).
+            executed = cluster.authorities[0].metric("executor_executed_transactions")
+            assert executed >= 64, executed
         finally:
             client.close()
             await cluster.shutdown()
