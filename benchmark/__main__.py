@@ -18,6 +18,11 @@ def main() -> None:
     ap.add_argument("--tx-size", type=int, default=512)
     ap.add_argument("--duration", type=int, default=20)
     ap.add_argument("--faults", type=int, default=0)
+    ap.add_argument("--consensus-protocol", choices=("bullshark", "tusk"),
+                    default="bullshark")
+    ap.add_argument("--crypto-backend", choices=("cpu", "pool", "tpu"),
+                    default="cpu")
+    ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
     args = ap.parse_args()
 
     bench = LocalBench(
@@ -28,6 +33,9 @@ def main() -> None:
             tx_size=args.tx_size,
             duration=args.duration,
             faults=args.faults,
+            consensus_protocol=args.consensus_protocol,
+            crypto_backend=args.crypto_backend,
+            dag_backend=args.dag_backend,
         )
     )
     print(bench.run().result())

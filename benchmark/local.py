@@ -42,6 +42,9 @@ class BenchParameters:
     tx_size: int = 512
     duration: int = 20
     faults: int = 0
+    consensus_protocol: str = "bullshark"  # | tusk
+    crypto_backend: str = "cpu"  # | pool | tpu
+    dag_backend: str = "cpu"  # | tpu
 
 
 class LocalBench:
@@ -137,7 +140,10 @@ class LocalBench:
             for i in range(alive):
                 self._spawn(
                     ["run", "--keys", f"{self.base}/key-{i}.json", *common,
-                     "--store", f"{self.base}/db-{i}", "primary"],
+                     "--store", f"{self.base}/db-{i}", "primary",
+                     "--consensus-protocol", bench.consensus_protocol,
+                     "--crypto-backend", bench.crypto_backend,
+                     "--dag-backend", bench.dag_backend],
                     f"{self.base}/primary-{i}.log",
                 )
                 for wid in range(bench.workers):

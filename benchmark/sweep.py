@@ -30,10 +30,16 @@ def run_once(rate: int, args) -> dict:
             tx_size=args.tx_size,
             duration=args.duration,
             faults=args.faults,
+            consensus_protocol=args.consensus_protocol,
+            crypto_backend=args.crypto_backend,
+            dag_backend=args.dag_backend,
         )
     )
     parser = bench.run()
     record = parser.to_dict()
+    record["consensus_protocol"] = args.consensus_protocol
+    record["crypto_backend"] = args.crypto_backend
+    record["dag_backend"] = args.dag_backend
     print(
         f"  rate {rate:>8,}: TPS {record['consensus_tps']:>10,.0f}  "
         f"lat {record['consensus_latency_ms']:>8,.0f} ms  "
@@ -99,6 +105,11 @@ def main() -> None:
     ap.add_argument("--tx-size", type=int, default=512)
     ap.add_argument("--duration", type=int, default=20)
     ap.add_argument("--faults", type=int, default=0)
+    ap.add_argument("--consensus-protocol", choices=("bullshark", "tusk"),
+                    default="bullshark")
+    ap.add_argument("--crypto-backend", choices=("cpu", "pool", "tpu"),
+                    default="cpu")
+    ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--rates", type=int, nargs="*", default=[5_000, 15_000, 30_000])
     ap.add_argument("--auto", action="store_true", help="geometric ramp to the knee")
     ap.add_argument("--start-rate", type=int, default=2_000)
