@@ -1,0 +1,45 @@
+"""The driver-contract multi-chip dry run, under pytest.
+
+MULTICHIP_r02.json shipped broken (`ok=false`) because nothing in CI ever
+executed `__graft_entry__.dryrun_multichip` — the only multi-chip evidence
+this environment can produce lived outside the test suite. These tests run
+the exact driver entry points on conftest's 8 virtual CPU devices so any
+regression in the sharded consensus step (mesh construction, in_shardings,
+the unsharded comparison leg's device pinning) fails the suite instead of
+the round artifact.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__
+
+
+def test_entry_compiles_and_runs():
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    # reach_mask returns a [W, N] mask covering the whole window.
+    W, N, _ = args[0].shape
+    assert out.shape == (W, N)
+
+
+@pytest.mark.parametrize("n_devices", [8, 4, 2, 1])
+def test_dryrun_multichip(n_devices):
+    # Pass the CPU device list explicitly: under pytest the default backend
+    # can still be the real chip (the axon plugin preregisters before
+    # conftest's JAX_PLATFORMS=cpu applies), and dryrun's small-backend
+    # fallback would not trigger for n_devices=1.
+    cpus = jax.devices("cpu")
+    if len(cpus) < n_devices:
+        pytest.skip(f"need {n_devices} cpu devices")
+    __graft_entry__.dryrun_multichip(n_devices, devices=cpus)
+
+
+def test_dryrun_multichip_odd_mesh():
+    """n_devices not divisible by 2 exercises the auth=1 mesh fallback."""
+    cpus = jax.devices("cpu")
+    if len(cpus) < 3:
+        pytest.skip("need 3 cpu devices")
+    __graft_entry__.dryrun_multichip(3, devices=cpus)
