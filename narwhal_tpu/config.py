@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import socket
+from collections import deque
 from dataclasses import asdict, dataclass, field, replace
 from typing import Mapping
 
@@ -329,13 +330,19 @@ class Shared:
 
 
 _HANDED_OUT: set[int] = set()
+_HANDED_ORDER: deque[int] = deque()
+# Recently-handed ports to avoid re-issuing before their server binds. A
+# bounded window: servers bind within moments of assignment, so only the
+# recent tail matters — an unbounded set would eventually exhaust the 64
+# bind attempts in a long-lived process that keeps building clusters.
+_HANDED_WINDOW = 1024
 
 
 def get_available_port(host: str = "127.0.0.1") -> int:
     """(/root/reference/config/src/utils.rs:9-33). Ports are pre-assigned
-    before servers bind them, so remember what we handed out within this
-    process and never hand the same port twice — the OS allocator can cycle
-    back to a port whose server has not bound yet."""
+    before servers bind them, so remember what we handed out recently within
+    this process and never hand the same port twice in that window — the OS
+    allocator can cycle back to a port whose server has not bound yet."""
     for _ in range(64):
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
             s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -343,5 +350,8 @@ def get_available_port(host: str = "127.0.0.1") -> int:
             port = s.getsockname()[1]
         if port not in _HANDED_OUT:
             _HANDED_OUT.add(port)
+            _HANDED_ORDER.append(port)
+            while len(_HANDED_ORDER) > _HANDED_WINDOW:
+                _HANDED_OUT.discard(_HANDED_ORDER.popleft())
             return port
     raise OSError("no available port after 64 attempts")

@@ -1,4 +1,4 @@
-from .auth import AuthError, Credentials, Peer, committee_resolver
+from .auth import AuthError, Credentials, Peer, cached_allow_sets, committee_resolver
 from .rpc import (
     NetworkClient,
     PeerClient,
@@ -16,5 +16,6 @@ __all__ = [
     "RetryConfig",
     "RpcError",
     "RpcServer",
+    "cached_allow_sets",
     "committee_resolver",
 ]

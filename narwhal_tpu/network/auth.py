@@ -153,6 +153,24 @@ def committee_resolver(get_committee, get_worker_cache) -> Callable[[str], Optio
     return resolve
 
 
+def cached_allow_sets(holder, committee, worker_cache, build):
+    """Identity-keyed memo of a node's allowed-key frozensets: the hot
+    protocol plane pays two `is` compares per frame instead of an O(N)
+    rebuild, and an epoch change (which swaps the committee/worker-cache
+    objects) invalidates the cache. The cache tuple holds strong references
+    to the keyed objects — keying on id() could serve a stale set to a new
+    committee allocated at a recycled address after the old one is freed.
+
+    `build()` returns the tuple of frozensets for the current objects; the
+    same tuple shape is returned on every call. The memo is stored on
+    `holder._auth_cache`."""
+    cached = getattr(holder, "_auth_cache", None)
+    if cached is None or cached[0] is not committee or cached[1] is not worker_cache:
+        cached = (committee, worker_cache, build())
+        holder._auth_cache = cached
+    return cached[2]
+
+
 def _raw_x25519_pub(priv: X25519PrivateKey) -> bytes:
     return priv.public_key().public_bytes(_ser.Encoding.Raw, _ser.PublicFormat.Raw)
 

@@ -257,6 +257,12 @@ class PeerClient:
 Handler = Callable[[object, Peer], Awaitable[object | None]]
 
 
+def ALLOW_ANY(peer: "Peer") -> bool:
+    """Explicit opt-out of route-level authorization on an authenticated
+    server: any handshake-verified peer may call the route."""
+    return True
+
+
 class RpcServer:
     """Listens for peers and dispatches requests to handlers by message tag.
 
@@ -282,6 +288,18 @@ class RpcServer:
         self._auth_keypair = auth_keypair
 
     def route(self, msg_cls, handler: Handler, allow=None) -> None:
+        # Deny-by-default on authenticated servers: the handshake only proves
+        # the peer holds *a* key, not that the key is known to the committee
+        # (the reference rejects unknown peers at the network layer via
+        # anemo's known-peers set). A route registered without an identity
+        # predicate would silently be world-open, so require one — ALLOW_ANY
+        # documents a deliberate opt-out.
+        if self._auth_keypair is not None and allow is None:
+            raise ValueError(
+                f"route {msg_cls.__name__}: authenticated servers are "
+                "deny-by-default; pass allow= (or ALLOW_ANY to open the "
+                "route to any handshake-verified peer)"
+            )
         self._handlers[msg_cls.TAG] = (handler, allow)
 
     async def start(self, host: str, port: int) -> int:

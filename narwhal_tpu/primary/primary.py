@@ -29,7 +29,7 @@ from ..messages import (
     VoteMsg,
 )
 from ..metrics import Registry
-from ..network import NetworkClient, RpcServer
+from ..network import NetworkClient, RpcServer, cached_allow_sets
 from ..stores import NodeStorage
 from ..types import Certificate, PublicKey, ReconfigureNotification
 from .certificate_waiter import CertificateWaiter
@@ -256,13 +256,8 @@ class Primary:
         )
 
     # -- authorization predicates ------------------------------------------
-    # Allowed-key sets are cached per (committee, worker_cache) object so the
-    # hot protocol plane pays a tuple compare per frame, not an O(N) scan;
-    # epoch changes swap the objects and invalidate the cache.
     def _auth_sets(self) -> tuple[frozenset, frozenset]:
-        key = (id(self.committee), id(self.worker_cache))
-        cached = getattr(self, "_auth_cache", None)
-        if cached is None or cached[0] != key:
+        def build():
             primaries = frozenset(
                 a.network_key for a in self.committee.authorities.values()
             )
@@ -270,9 +265,9 @@ class Primary:
                 info.name
                 for info in self.worker_cache.our_workers(self.name).values()
             )
-            cached = (key, primaries, workers)
-            self._auth_cache = cached
-        return cached[1], cached[2]
+            return primaries, workers
+
+        return cached_allow_sets(self, self.committee, self.worker_cache, build)
 
     def _allow_peer_primary(self, peer) -> bool:
         """Any committee authority's primary network identity."""

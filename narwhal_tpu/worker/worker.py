@@ -36,7 +36,7 @@ from ..messages import (
     WorkerBatchResponse,
 )
 from ..metrics import Registry
-from ..network import NetworkClient, RpcServer
+from ..network import NetworkClient, RpcServer, cached_allow_sets
 from ..stores import BatchStore
 from ..types import (
     Batch,
@@ -215,12 +215,8 @@ class Worker:
 
     # -- handlers ---------------------------------------------------------
     # -- authorization predicates (handshake-verified peer identity) -------
-    # Allowed-key sets cached per (committee, worker_cache) object: a tuple
-    # compare per frame on the hot batch plane, invalidated on epoch change.
     def _auth_sets(self) -> tuple[frozenset, frozenset]:
-        key = (id(self.committee), id(self.worker_cache))
-        cached = getattr(self, "_auth_cache", None)
-        if cached is None or cached[0] != key:
+        def build():
             lane = frozenset(
                 {self.worker_cache.worker(self.name, self.worker_id).name}
                 | {
@@ -231,9 +227,9 @@ class Worker:
                 }
             )
             own_primary = frozenset({self.committee.network_key(self.name)})
-            cached = (key, lane, own_primary)
-            self._auth_cache = cached
-        return cached[1], cached[2]
+            return lane, own_primary
+
+        return cached_allow_sets(self, self.committee, self.worker_cache, build)
 
     def _allow_peer_worker(self, peer) -> bool:
         """Same-lane workers of any committee authority (incl. ourselves)."""

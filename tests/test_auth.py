@@ -236,3 +236,22 @@ def test_client_rejects_wrong_server_identity(run):
             await imposter.stop()
 
     run(scenario())
+
+
+def test_authenticated_server_is_deny_by_default():
+    """A route registered on an authenticated server without an allow
+    predicate must be rejected at registration time: the handshake proves
+    key possession, not committee membership, so an unrestricted route
+    would silently be world-open (ADVICE r2)."""
+    import pytest
+
+    from narwhal_tpu.network.rpc import ALLOW_ANY
+
+    srv = RpcServer(auth_keypair=KeyPair.generate())
+    with pytest.raises(ValueError, match="deny-by-default"):
+        srv.route(CleanupMsg, lambda msg, peer: None)
+    # Explicit opt-out and explicit predicates still register.
+    srv.route(CleanupMsg, lambda msg, peer: None, allow=ALLOW_ANY)
+    srv.route(SynchronizeMsg, lambda msg, peer: None, allow=lambda p: False)
+    # Unauthenticated (public-plane) servers keep the permissive default.
+    RpcServer().route(CleanupMsg, lambda msg, peer: None)
