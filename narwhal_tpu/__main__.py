@@ -104,6 +104,7 @@ async def _run_node(args) -> None:
             consensus_protocol=getattr(args, "consensus_protocol", "bullshark"),
             crypto_backend=getattr(args, "crypto_backend", "cpu"),
             dag_backend=getattr(args, "dag_backend", "cpu"),
+            dag_shards=getattr(args, "dag_shards", 1),
             network_keypair=network_keypair,
         )
         await node.spawn()
@@ -187,6 +188,11 @@ def main(argv: list[str] | None = None) -> None:
         "--dag-backend", choices=("cpu", "tpu"), default="cpu",
         help="consensus commit walk: host order_dag (cpu) or the on-device "
         "adjacency-tensor kernels (tpu)",
+    )
+    p.add_argument(
+        "--dag-shards", type=int, default=1,
+        help="with --dag-backend tpu: shard the committee axis of the DAG "
+        "window over this many devices (an 'auth' mesh; 1 = single device)",
     )
     p.add_argument(
         "--consensus-protocol", choices=("bullshark", "tusk"), default="bullshark",

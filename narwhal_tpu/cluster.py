@@ -73,6 +73,7 @@ class Cluster:
         store_base: str | None = None,
         crypto_backend: str = "cpu",
         dag_backend: str = "cpu",
+        dag_shards: int = 1,
         consensus_protocol: str = "bullshark",
     ):
         self.fixture = CommitteeFixture(size=size, workers=workers)
@@ -84,6 +85,7 @@ class Cluster:
         self.store_base = store_base
         self.crypto_backend = crypto_backend
         self.dag_backend = dag_backend
+        self.dag_shards = dag_shards
         self.consensus_protocol = consensus_protocol
         # Pre-assign real ports so no early broadcast targets a placeholder.
         committee = self.fixture.committee
@@ -125,6 +127,7 @@ class Cluster:
             consensus_protocol=self.consensus_protocol,
             crypto_backend=self.crypto_backend,
             dag_backend=self.dag_backend,
+            dag_shards=self.dag_shards,
             network_keypair=fixture_auth.network_keypair,
         )
         await details.primary.spawn()

@@ -80,6 +80,7 @@ class PrimaryNode:
         registry: Registry | None = None,
         crypto_backend: str = "cpu",  # cpu | pool | tpu
         dag_backend: str = "cpu",  # cpu | tpu
+        dag_shards: int = 1,  # devices on the mesh's 'auth' axis (tpu backend)
         network_keypair: KeyPair | None = None,
     ):
         self.keypair = keypair
@@ -146,8 +147,30 @@ class PrimaryNode:
                 protocol_cls = {"bullshark": TpuBullshark, "tusk": TpuTusk}[
                     consensus_protocol
                 ]
+                # --dag-shards > 1: shard the committee axis of the window
+                # over an 'auth' device mesh (ICI collectives). The CPU
+                # fallback only helps when the host platform is forced to
+                # multiple virtual devices (tests/dryrun set
+                # xla_force_host_platform_device_count); a plain single-chip
+                # host raises rather than silently degrading.
+                mesh = None
+                if dag_shards > 1:
+                    import jax
+                    import numpy as _np
+                    from jax.sharding import Mesh
+
+                    devs = jax.devices()
+                    if len(devs) < dag_shards:
+                        devs = jax.devices("cpu")
+                    if len(devs) < dag_shards:
+                        raise ValueError(
+                            f"--dag-shards {dag_shards} exceeds available "
+                            f"devices ({len(devs)})"
+                        )
+                    mesh = Mesh(_np.array(devs[:dag_shards]), ("auth",))
                 protocol = protocol_cls(
-                    committee, storage.consensus_store, parameters.gc_depth
+                    committee, storage.consensus_store, parameters.gc_depth,
+                    mesh=mesh,
                 )
             else:
                 protocol_cls = {"bullshark": Bullshark, "tusk": Tusk}[

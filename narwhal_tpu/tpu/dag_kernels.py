@@ -122,16 +122,30 @@ class DagWindow:
     """Host-managed ring of the last W rounds as dense arrays, with the
     digest <-> (round, authority) maps the tensors can't hold. This is the
     'long context' of the system: rounds are the sequence axis, the committee
-    the width (SURVEY §5.8)."""
+    the width (SURVEY §5.8).
 
-    def __init__(self, committee: Committee, window: int = 64):
+    `pad_authorities_to` widens the committee axis of the tensors with
+    always-absent slots (present=0, stake=0) so the axis divides evenly
+    across a device mesh's 'auth' dimension; padding is invisible to the
+    protocol — padded slots never hold certificates, relay reachability or
+    carry stake."""
+
+    def __init__(
+        self,
+        committee: Committee,
+        window: int = 64,
+        pad_authorities_to: int | None = None,
+    ):
         self.committee = committee
-        self.N = committee.size()
+        n = committee.size()
+        self.N = max(n, pad_authorities_to or 0)
         self.W = window
         self.round_base: Round = 0
         self.present = np.zeros((self.W, self.N), np.uint8)
         self.parent = np.zeros((self.W, self.N, self.N), np.uint8)
-        self.stakes = np.asarray(committee.stakes_array(), np.int32)
+        stakes = np.zeros((self.N,), np.int32)
+        stakes[:n] = np.asarray(committee.stakes_array(), np.int32)
+        self.stakes = stakes
         self.certs: dict[tuple[Round, int], Certificate] = {}
         self.digest_pos: dict[Digest, tuple[Round, int]] = {}
         # Genesis certificates occupy round 0.
@@ -207,7 +221,16 @@ class TpuBullshark:
     """Bullshark with the DAG walks on device. Drop-in for
     consensus.Bullshark (same process_certificate signature/semantics,
     equivalence-tested); the host retains only bookkeeping and the final
-    index->certificate gather."""
+    index->certificate gather.
+
+    With `mesh` set (a jax.sharding.Mesh containing an 'auth' axis) the
+    production chain_commit dispatch shards the committee axis of the DAG
+    tensors across devices — parent [W,N,N] over its link axis, present
+    [W,N] and last_committed [N] over N — exactly the layout
+    __graft_entry__.dryrun_multichip validates; XLA inserts the ICI
+    collectives for the per-round frontier psum (SURVEY §5.8: the window as
+    a first-class sharding axis). The committee axis is padded to a
+    multiple of the 'auth' size with always-absent slots."""
 
     def __init__(
         self,
@@ -216,12 +239,54 @@ class TpuBullshark:
         gc_depth: Round,
         leader_fn=None,
         window: int | None = None,
+        mesh=None,
     ):
         self.committee = committee
         self.store = store
         self.gc_depth = gc_depth
         self._leader_fn = leader_fn
-        self.win = DagWindow(committee, window or (gc_depth + 14))
+        self.mesh = mesh
+        self.win = DagWindow(
+            committee, window or (gc_depth + 14),
+            pad_authorities_to=self._pad_for(committee),
+        )
+        self._chain_commit = self._build_dispatch()
+
+    def _pad_for(self, committee: Committee) -> int | None:
+        """Committee-axis width the mesh requires: the next multiple of the
+        'auth' axis size (None when unmeshed)."""
+        if self.mesh is None:
+            return None
+        auth = self.mesh.shape["auth"]
+        return -(-committee.size() // auth) * auth
+
+    def _build_dispatch(self):
+        """The chain_commit entry point: the module-level jit on a single
+        device, or a mesh-sharded jit when a mesh is configured. Scalars and
+        the small per-leader operands are replicated (NamedSharding with an
+        empty spec) so no operand ever falls back to the default backend's
+        device placement."""
+        if self.mesh is None:
+            return chain_commit
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def s(*spec):
+            return NamedSharding(self.mesh, P(*spec))
+
+        return jax.jit(
+            chain_commit,
+            in_shardings=(
+                s(None, None, "auth"),  # parent [W, N, N]: link axis
+                s(None, "auth"),  # present [W, N]
+                s(),  # gc_depth scalar
+                s("auth"),  # lc_rel [N]
+                s(),  # lcr_rel scalar
+                s(),  # offs [K]
+                s(None, None),  # onehots [K, N]
+            ),
+            out_shardings=s(None, None, "auth"),
+        )
 
     def recover(self, state: ConsensusState) -> None:
         """Rebuild the device window from a recovered host state (the
@@ -384,14 +449,16 @@ class TpuBullshark:
             offs[i] = self.win._off(lr)
             onehots[i, lidx] = 1
 
-        masks_dev = chain_commit(
-            jnp.asarray(self.win.parent),
-            jnp.asarray(self.win.present),
-            jnp.int32(self.gc_depth),
-            jnp.asarray(self._lc_rel(state)),
-            jnp.int32(state.last_committed_round - self.win.round_base),
-            jnp.asarray(offs),
-            jnp.asarray(onehots),
+        # Numpy operands: the dispatch places them — per in_shardings on the
+        # mesh when configured, on the default device otherwise.
+        masks_dev = self._chain_commit(
+            self.win.parent,
+            self.win.present,
+            np.int32(self.gc_depth),
+            self._lc_rel(state),
+            np.int32(state.last_committed_round - self.win.round_base),
+            offs,
+            onehots,
         )
         # Start the device->host copy as soon as the walk finishes so the
         # materialization readback finds the masks already local.
@@ -426,7 +493,9 @@ class TpuBullshark:
 
     def update_committee(self, new_committee: Committee) -> None:
         self.committee = new_committee
-        self.win = DagWindow(new_committee, self.win.W)
+        self.win = DagWindow(
+            new_committee, self.win.W, pad_authorities_to=self._pad_for(new_committee)
+        )
 
 
 class TpuTusk(TpuBullshark):

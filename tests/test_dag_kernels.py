@@ -21,7 +21,7 @@ GC = 50
 
 def _run_both(
     size, rounds, failure, seed, gc=GC, leader_fn=fixed_leader, window=None,
-    host_cls=Bullshark, dev_cls=TpuBullshark,
+    host_cls=Bullshark, dev_cls=TpuBullshark, dev_kwargs=None,
 ):
     f = CommitteeFixture(size=size)
     genesis = {c.digest for c in Certificate.genesis(f.committee)}
@@ -33,7 +33,7 @@ def _run_both(
     tpu_state = ConsensusState(Certificate.genesis(f.committee))
     host = host_cls(f.committee, NodeStorage(None).consensus_store, gc, leader_fn=leader_fn)
     dev = dev_cls(f.committee, NodeStorage(None).consensus_store, gc,
-                  leader_fn=leader_fn, window=window)
+                  leader_fn=leader_fn, window=window, **(dev_kwargs or {}))
     host_seq, dev_seq = [], []
     hi = di = 0
     for c in certs:
@@ -94,6 +94,58 @@ def test_equivalence_tusk_optimal_and_lossy():
         size=7, rounds=20, failure=0.15, seed=2,
         leader_fn=None, host_cls=Tusk, dev_cls=TpuTusk,
     )
+
+
+def _auth_mesh(auth, data=1):
+    """A CPU device mesh with an 'auth' axis (and optionally a leading
+    'data' axis) for the production engine's sharded dispatch."""
+    import jax
+    from jax.sharding import Mesh
+
+    cpus = jax.devices("cpu")
+    need = auth * data
+    if len(cpus) < need:
+        pytest.skip(f"need {need} cpu devices")
+    if data > 1:
+        return Mesh(np.array(cpus[:need]).reshape(data, auth), ("data", "auth"))
+    return Mesh(np.array(cpus[:auth]), ("auth",))
+
+
+def test_equivalence_mesh_sharded():
+    """The PRODUCTION TpuBullshark with a 4-device 'auth' mesh: the real
+    chain_commit dispatch shards the committee axis and must stay
+    bit-for-bit equivalent to the host engine (VERDICT r2 #2)."""
+    _run_both(size=4, rounds=20, failure=0.2, seed=0,
+              dev_kwargs={"mesh": _auth_mesh(4)})
+
+
+def test_equivalence_mesh_padded_committee():
+    """Committee size (7) not divisible by the auth axis (2): the window
+    pads the committee axis with absent slots; commits are unchanged."""
+    _run_both(size=7, rounds=15, failure=0.15, seed=1, leader_fn=None,
+              dev_kwargs={"mesh": _auth_mesh(2)})
+
+
+def test_equivalence_mesh_two_axis():
+    """A 2-axis (data x auth) mesh — the dryrun_multichip layout — behind
+    the production engine: specs name only 'auth', 'data' is replicated."""
+    _run_both(size=4, rounds=20, failure=0.3, seed=3,
+              dev_kwargs={"mesh": _auth_mesh(2, data=4)})
+
+
+def test_equivalence_mesh_tusk():
+    from narwhal_tpu.consensus import Tusk
+    from narwhal_tpu.tpu.dag_kernels import TpuTusk
+
+    _run_both(size=4, rounds=20, failure=0.3, seed=2, host_cls=Tusk,
+              dev_cls=TpuTusk, dev_kwargs={"mesh": _auth_mesh(2)})
+
+
+def test_mesh_window_slides_and_grows():
+    """Sliding + growth still work when the dispatch is mesh-sharded (the
+    doubled W recompiles the sharded jit)."""
+    _run_both(size=4, rounds=60, failure=0.0, seed=0, gc=10, window=24,
+              dev_kwargs={"mesh": _auth_mesh(4)})
 
 
 def test_window_grows_when_no_commits():

@@ -245,3 +245,43 @@ def test_cluster_with_verification_pool(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=90.0)
+
+
+def test_cluster_with_sharded_tpu_dag_backend(run, tmp_path):
+    """--dag-backend tpu --dag-shards 2: the node wires a mesh into
+    TpuBullshark, whose production chain_commit dispatch shards the
+    committee axis across two devices. The committee still commits and
+    executes transactions identically on every node."""
+
+    async def scenario():
+        cluster = Cluster(
+            size=4, workers=1, store_base=str(tmp_path),
+            dag_backend="tpu", dag_shards=2,
+        )
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            proto = cluster.authorities[0].primary.consensus.protocol
+            assert proto.mesh is not None and proto.mesh.shape["auth"] == 2
+            target = cluster.authorities[0].worker_transactions_address(0)
+            txs = tuple(bytes([9]) * 8 + bytes([i]) for i in range(16))
+            await client.request(target, SubmitTransactionStreamMsg(txs))
+
+            async def executed(details, count):
+                out = []
+                while len(out) < count:
+                    _, tx = await asyncio.wait_for(
+                        details.primary.tx_execution_output.recv(), 30.0
+                    )
+                    out.append(tx)
+                return out
+
+            results = await asyncio.gather(
+                *(executed(a, 16) for a in cluster.authorities)
+            )
+            assert all(r == results[0] for r in results)
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
