@@ -23,6 +23,7 @@ def main() -> None:
     ap.add_argument("--crypto-backend", choices=("cpu", "pool", "tpu"),
                     default="cpu")
     ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
+    ap.add_argument("--dag-shards", type=int, default=1)
     args = ap.parse_args()
 
     bench = LocalBench(
@@ -36,6 +37,7 @@ def main() -> None:
             consensus_protocol=args.consensus_protocol,
             crypto_backend=args.crypto_backend,
             dag_backend=args.dag_backend,
+            dag_shards=args.dag_shards,
         )
     )
     print(bench.run().result())

@@ -45,6 +45,7 @@ class BenchParameters:
     consensus_protocol: str = "bullshark"  # | tusk
     crypto_backend: str = "cpu"  # | pool | tpu
     dag_backend: str = "cpu"  # | tpu
+    dag_shards: int = 1  # committee-axis device shards (tpu backend)
 
 
 class LocalBench:
@@ -104,6 +105,13 @@ class LocalBench:
     def _spawn(self, argv: list[str], log_path: str) -> None:
         log = open(log_path, "w")
         env = dict(os.environ, PYTHONPATH=os.path.dirname(self.base) or ".")
+        if env.get("JAX_PLATFORMS") == "cpu":
+            # The axon TPU plugin self-registers via sitecustomize whenever
+            # PALLAS_AXON_POOL_IPS is set and wins over JAX_PLATFORMS; a
+            # fleet of node subprocesses would then all dial the single
+            # tunneled chip and stall in client init. An explicit cpu
+            # request means virtual/CPU devices: keep the plugin out.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         self.procs.append(
             subprocess.Popen(
                 [sys.executable, "-m", "narwhal_tpu", "-v", *argv],
@@ -113,6 +121,32 @@ class LocalBench:
                 cwd=os.path.dirname(os.path.abspath(__file__)) + "/..",
             )
         )
+
+    def _wait_for_boot(self, paths: list[str], timeout: float = 180.0) -> None:
+        """Block until every node log shows its boot line (the reference's
+        fab-local pattern of parsing 'successfully booted'): the load window
+        must not start while nodes are still importing jax/compiling —
+        concurrent cold starts on a shared core can take tens of seconds,
+        which would otherwise be billed to the measurement duration."""
+        deadline = time.time() + timeout
+        pending = set(paths)
+        while pending and time.time() < deadline:
+            for path in list(pending):
+                try:
+                    with open(path) as fh:
+                        if "successfully booted" in fh.read():
+                            pending.discard(path)
+                except OSError:
+                    pass
+            for proc in self.procs:
+                if proc.poll() not in (None, 0):
+                    raise RuntimeError("a node process exited during boot")
+            if pending:
+                time.sleep(0.5)
+        if pending:
+            raise RuntimeError(
+                f"nodes failed to boot within {timeout}s: {sorted(pending)}"
+            )
 
     def _kill_all(self) -> None:
         for p in self.procs:
@@ -143,7 +177,8 @@ class LocalBench:
                      "--store", f"{self.base}/db-{i}", "primary",
                      "--consensus-protocol", bench.consensus_protocol,
                      "--crypto-backend", bench.crypto_backend,
-                     "--dag-backend", bench.dag_backend],
+                     "--dag-backend", bench.dag_backend,
+                     "--dag-shards", str(bench.dag_shards)],
                     f"{self.base}/primary-{i}.log",
                 )
                 for wid in range(bench.workers):
@@ -152,6 +187,14 @@ class LocalBench:
                          "--store", f"{self.base}/db-{i}", "worker", "--id", str(wid)],
                         f"{self.base}/worker-{i}-{wid}.log",
                     )
+            self._wait_for_boot(
+                [f"{self.base}/primary-{i}.log" for i in range(alive)]
+                + [
+                    f"{self.base}/worker-{i}-{wid}.log"
+                    for i in range(alive)
+                    for wid in range(bench.workers)
+                ]
+            )
             # One client per alive worker lane (local.py: rate share).
             lanes = [
                 workers[keys[i]][wid].transactions

@@ -33,6 +33,7 @@ def run_once(rate: int, args) -> dict:
             consensus_protocol=args.consensus_protocol,
             crypto_backend=args.crypto_backend,
             dag_backend=args.dag_backend,
+            dag_shards=args.dag_shards,
         )
     )
     parser = bench.run()
@@ -40,6 +41,7 @@ def run_once(rate: int, args) -> dict:
     record["consensus_protocol"] = args.consensus_protocol
     record["crypto_backend"] = args.crypto_backend
     record["dag_backend"] = args.dag_backend
+    record["dag_shards"] = args.dag_shards
     print(
         f"  rate {rate:>8,}: TPS {record['consensus_tps']:>10,.0f}  "
         f"lat {record['consensus_latency_ms']:>8,.0f} ms  "
@@ -110,6 +112,7 @@ def main() -> None:
     ap.add_argument("--crypto-backend", choices=("cpu", "pool", "tpu"),
                     default="cpu")
     ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
+    ap.add_argument("--dag-shards", type=int, default=1)
     ap.add_argument("--rates", type=int, nargs="*", default=[5_000, 15_000, 30_000])
     ap.add_argument("--auto", action="store_true", help="geometric ramp to the knee")
     ap.add_argument("--start-rate", type=int, default=2_000)

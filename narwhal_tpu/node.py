@@ -137,6 +137,11 @@ class PrimaryNode:
         self.executor: Executor | None = None
         self.dag: Dag | None = None
         self.execution_state = execution_state or SimpleExecutionState(storage)
+        if dag_shards > 1 and dag_backend != "tpu":
+            raise ValueError(
+                f"--dag-shards {dag_shards} requires --dag-backend tpu "
+                f"(got {dag_backend!r})"
+            )
         if internal_consensus:
             # --dag-backend tpu: the commit walk runs on device via the
             # adjacency-tensor kernels (SURVEY §7.8c; the reference's
@@ -152,7 +157,9 @@ class PrimaryNode:
                 # fallback only helps when the host platform is forced to
                 # multiple virtual devices (tests/dryrun set
                 # xla_force_host_platform_device_count); a plain single-chip
-                # host raises rather than silently degrading.
+                # host raises rather than silently degrading, and falling
+                # back from a too-small accelerator platform is logged so
+                # no benchmark silently attributes CPU numbers to the chip.
                 mesh = None
                 if dag_shards > 1:
                     import jax
@@ -161,12 +168,20 @@ class PrimaryNode:
 
                     devs = jax.devices()
                     if len(devs) < dag_shards:
-                        devs = jax.devices("cpu")
-                    if len(devs) < dag_shards:
-                        raise ValueError(
-                            f"--dag-shards {dag_shards} exceeds available "
-                            f"devices ({len(devs)})"
+                        cpus = jax.devices("cpu")
+                        if len(cpus) < dag_shards:
+                            raise ValueError(
+                                f"--dag-shards {dag_shards} exceeds available "
+                                f"devices ({len(devs)} {devs[0].platform}, "
+                                f"{len(cpus)} cpu)"
+                            )
+                        logger.warning(
+                            "--dag-shards %d exceeds the %d-device %s "
+                            "backend; sharding over %d virtual CPU devices "
+                            "instead",
+                            dag_shards, len(devs), devs[0].platform, dag_shards,
                         )
+                        devs = cpus
                     mesh = Mesh(_np.array(devs[:dag_shards]), ("auth",))
                 protocol = protocol_cls(
                     committee, storage.consensus_store, parameters.gc_depth,

@@ -22,7 +22,17 @@ from ..types import Batch, Certificate, Digest, PublicKey, serialized_batch_dige
 
 logger = logging.getLogger("narwhal.primary")
 
+# Per-batch worker deadline (block_waiter.rs BATCH_RETRIEVE_TIMEOUT = 10s)
+# and a short bounded retry for transient transport failures — a worker
+# restarting mid-fetch should not fail the whole block.
 BATCH_RETRIEVE_TIMEOUT = 10.0
+BATCH_RETRY_ATTEMPTS = 3
+BATCH_RETRY_DELAY = 0.25
+
+
+class _BatchTimeout(Exception):
+    """Internal: a worker held the connection but exceeded the per-batch
+    deadline (distinct from transport errors, which map to BatchError)."""
 
 
 class BlockError(Exception):
@@ -46,12 +56,18 @@ class BlockWaiter:
         certificate_store: CertificateStore,
         network: NetworkClient,
         block_synchronizer=None,  # optional: fetch unknown certs from peers
+        batch_timeout: float = BATCH_RETRIEVE_TIMEOUT,
+        retry_attempts: int = BATCH_RETRY_ATTEMPTS,
+        retry_delay: float = BATCH_RETRY_DELAY,
     ):
         self.name = name
         self.worker_cache = worker_cache
         self.certificate_store = certificate_store
         self.network = network
         self.block_synchronizer = block_synchronizer
+        self.batch_timeout = batch_timeout
+        self.retry_attempts = retry_attempts
+        self.retry_delay = retry_delay
         # Dedup map: one in-flight fetch per block digest
         # (block_waiter.rs pending_get_block).
         self._pending: dict[Digest, asyncio.Future] = {}
@@ -92,25 +108,69 @@ class BlockWaiter:
         if certificate is None:
             raise BlockError(digest, "BlockNotFound")
         payload = list(certificate.header.payload.items())
-        try:
-            batches = await asyncio.wait_for(
-                asyncio.gather(
-                    *(self._fetch_batch(d, w) for d, w in payload)
-                ),
-                BATCH_RETRIEVE_TIMEOUT,
-            )
-        except asyncio.TimeoutError:
-            raise BlockError(digest, "BatchTimeout") from None
-        except (RpcError, OSError, KeyError) as e:
-            logger.debug("block %s batch error: %s", digest.hex()[:16], e)
-            raise BlockError(digest, "BatchError") from None
-        return BlockResponse(digest, list(zip((d for d, _ in payload), batches)))
+        # return_exceptions keeps sibling batch fetches from running on
+        # unobserved after the first failure; a timeout anywhere outranks
+        # transport errors in the reported kind (block_waiter.rs maps the
+        # per-batch deadline to BatchTimeout).
+        results = await asyncio.gather(
+            *(self._fetch_batch(d, w) for d, w in payload), return_exceptions=True
+        )
+        if any(isinstance(r, _BatchTimeout) for r in results):
+            raise BlockError(digest, "BatchTimeout")
+        for r in results:
+            if isinstance(r, BaseException):
+                logger.debug("block %s batch error: %s", digest.hex()[:16], r)
+                raise BlockError(digest, "BatchError")
+        return BlockResponse(digest, list(zip((d for d, _ in payload), results)))
 
     async def _fetch_batch(self, batch_digest: Digest, worker_id: int) -> Batch:
+        """One batch from the worker that holds it, under the per-batch
+        deadline; transient transport failures retry a bounded number of
+        times so a restarting worker doesn't fail the block."""
         info = self.worker_cache.worker(self.name, worker_id)
-        resp: RequestedBatchMsg = await self.network.request(
-            info.worker_address, RequestBatchMsg(batch_digest)
+        last: Exception | None = None
+        # One deadline covers ALL attempts: retries are for fast transport
+        # failures (connection refused while a worker restarts) and must not
+        # stretch the reference's hard per-batch bound.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.batch_timeout
+        for attempt in range(self.retry_attempts):
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            try:
+                resp: RequestedBatchMsg = await asyncio.wait_for(
+                    self.network.request(
+                        info.worker_address, RequestBatchMsg(batch_digest),
+                        timeout=None,
+                    ),
+                    remaining,
+                )
+            except asyncio.TimeoutError:
+                raise _BatchTimeout(
+                    f"worker {worker_id} batch {batch_digest.hex()[:16]} "
+                    f"deadline ({self.batch_timeout}s)"
+                ) from None
+            except (RpcError, OSError) as e:
+                last = e
+                if attempt + 1 < self.retry_attempts:
+                    await asyncio.sleep(
+                        min(self.retry_delay * (attempt + 1),
+                            max(0.0, deadline - loop.time()))
+                    )
+                continue
+            if (
+                not resp.found
+                or serialized_batch_digest(resp.serialized_batch) != batch_digest
+            ):
+                # The worker answered authoritatively: retrying won't help.
+                raise RpcError(
+                    f"worker {worker_id} lacks batch {batch_digest.hex()[:16]}"
+                )
+            return Batch.from_bytes(resp.serialized_batch)
+        if last is not None:
+            raise last
+        raise _BatchTimeout(
+            f"worker {worker_id} batch {batch_digest.hex()[:16]} "
+            f"deadline ({self.batch_timeout}s)"
         )
-        if not resp.found or serialized_batch_digest(resp.serialized_batch) != batch_digest:
-            raise RpcError(f"worker {worker_id} lacks batch {batch_digest.hex()[:16]}")
-        return Batch.from_bytes(resp.serialized_batch)
