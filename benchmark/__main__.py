@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import argparse
 
+from narwhal_tpu.config import Parameters
+
 from .local import BenchParameters, LocalBench
 
 
@@ -24,6 +26,9 @@ def main() -> None:
                     default="cpu")
     ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--dag-shards", type=int, default=1)
+    ap.add_argument("--max-header-delay", type=float, default=0.1,
+                    help="proposer timer (s); slow it on core-starved hosts")
+    ap.add_argument("--max-batch-delay", type=float, default=0.1)
     args = ap.parse_args()
 
     bench = LocalBench(
@@ -38,7 +43,11 @@ def main() -> None:
             crypto_backend=args.crypto_backend,
             dag_backend=args.dag_backend,
             dag_shards=args.dag_shards,
-        )
+        ),
+        node_parameters=Parameters(
+            max_header_delay=args.max_header_delay,
+            max_batch_delay=args.max_batch_delay,
+        ),
     )
     print(bench.run().result())
 

@@ -1,0 +1,180 @@
+"""In-process committee benchmark: the whole committee as asyncio tasks in
+ONE process (the test harness Cluster, reference
+test_utils/src/cluster.rs:31-793), with rate-controlled load and
+executed-transaction measurement.
+
+Two reasons this exists next to the multi-process LocalBench:
+
+1. Committee scaling on small hosts. A 20-node LocalBench spawns 60+
+   Python processes; on a 1-2 core host the measurement is dominated by
+   scheduler thrash, not the protocol. One asyncio process loses far less
+   to context switching, so larger committees produce meaningful numbers.
+2. TPU backends. Only one process can own the (tunneled) chip, so the
+   crypto/DAG offload backends can serve a whole in-process committee —
+   the only way on this host to measure offload as *system* throughput.
+
+    python -m benchmark.inprocess --nodes 20 --rate 1000 --duration 40
+    python -m benchmark.inprocess --nodes 20 --crypto-backend tpu ...
+
+Emits one JSON record (tps/latency percentiles/config) on stdout and
+optionally appends it to --out.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import statistics
+import sys
+import time
+
+
+async def run_bench(args) -> dict:
+    from narwhal_tpu.cluster import Cluster
+    from narwhal_tpu.messages import SubmitTransactionStreamMsg
+    from narwhal_tpu.network import NetworkClient
+
+    cluster = Cluster(
+        size=args.nodes,
+        workers=args.workers,
+        crypto_backend=args.crypto_backend,
+        dag_backend=args.dag_backend,
+        dag_shards=args.dag_shards,
+        consensus_protocol=args.consensus_protocol,
+    )
+    await cluster.start(args.nodes - args.faults)
+    await cluster.assert_progress(commit_threshold=2, timeout=120.0)
+
+    alive = args.nodes - args.faults
+    executed = [0] * alive
+    # Per-node execution-order prefixes (first 9 bytes identify a sample
+    # tx): compared up to the shortest node so in-flight tails at cancel
+    # time can't fake a divergence, and count-only equality can't hide one.
+    orders: list[list[bytes]] = [[] for _ in range(alive)]
+    latencies: list[float] = []
+    sent_at: dict[int, float] = {}
+
+    async def drain(i: int) -> None:
+        ch = cluster.authorities[i].primary.tx_execution_output
+        while True:
+            _, tx = await ch.recv()
+            executed[i] += 1
+            orders[i].append(bytes(tx[:9]))
+            # Sample txs carry a sequence id (benchmark_client format:
+            # 0x00 + u64 counter) for end-to-end latency.
+            if i == 0 and tx[:1] == b"\x00":
+                sid = int.from_bytes(tx[1:9], "big")
+                t0 = sent_at.pop(sid, None)
+                if t0 is not None:
+                    latencies.append(time.time() - t0)
+
+    drains = [asyncio.ensure_future(drain(i)) for i in range(alive)]
+    client = NetworkClient()
+    lanes = [
+        cluster.authorities[i].worker_transactions_address(wid)
+        for i in range(alive)
+        for wid in range(args.workers)
+    ]
+    share = max(1, args.rate // len(lanes))
+    next_sid = 0
+
+    async def inject(lane: str) -> None:
+        nonlocal next_sid
+        end = time.time() + args.duration
+        while time.time() < end:
+            tick = time.time()
+            txs = []
+            for _ in range(share):
+                next_sid += 1
+                sid = next_sid
+                sent_at[sid] = time.time()
+                txs.append(
+                    b"\x00" + sid.to_bytes(8, "big") + b"\x01" * (args.tx_size - 9)
+                )
+            try:
+                await client.request(lane, SubmitTransactionStreamMsg(tuple(txs)))
+            except Exception as e:  # lane hiccup: drop this tick's share
+                print(f"inject {lane}: {e}", file=sys.stderr)
+            await asyncio.sleep(max(0.0, 1.0 - (time.time() - tick)))
+
+    t_start = time.time()
+    await asyncio.gather(*(inject(lane) for lane in lanes))
+    await asyncio.sleep(args.drain_tail)
+    window = time.time() - t_start
+    for d in drains:
+        d.cancel()
+    client.close()
+    await cluster.shutdown()
+
+    tps = executed[0] / window if executed[0] else 0.0
+    lat_sorted = sorted(latencies)
+
+    def pct(p: float) -> float:
+        if not lat_sorted:
+            return 0.0
+        return lat_sorted[min(len(lat_sorted) - 1, int(p * len(lat_sorted)))]
+
+    return {
+        "mode": "in-process",
+        "committee_size": args.nodes,
+        "workers_per_node": args.workers,
+        "faults": args.faults,
+        "input_rate": args.rate,
+        "tx_size": args.tx_size,
+        "duration_s": round(window, 1),
+        "consensus_protocol": args.consensus_protocol,
+        "crypto_backend": args.crypto_backend,
+        "dag_backend": args.dag_backend,
+        "dag_shards": args.dag_shards,
+        "executed_tps": round(tps, 1),
+        "executed_total": executed[0],
+        "identical_execution_prefix": (
+            (lambda L: all(o[:L] == orders[0][:L] for o in orders))(
+                min(len(o) for o in orders)
+            )
+            if orders
+            else True
+        ),
+        "compared_prefix_len": min(len(o) for o in orders) if orders else 0,
+        "e2e_latency_p50_ms": round(pct(0.50) * 1000, 1),
+        "e2e_latency_p90_ms": round(pct(0.90) * 1000, 1),
+        "latency_samples": len(lat_sorted),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.inprocess")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--rate", type=int, default=1_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=30)
+    ap.add_argument("--drain-tail", type=float, default=5.0)
+    ap.add_argument("--faults", type=int, default=0)
+    ap.add_argument("--consensus-protocol", choices=("bullshark", "tusk"),
+                    default="bullshark")
+    ap.add_argument("--crypto-backend", choices=("cpu", "pool", "tpu"),
+                    default="cpu")
+    ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
+    ap.add_argument("--dag-shards", type=int, default=1)
+    ap.add_argument("--out", default=None,
+                    help="append the JSON record to this file")
+    args = ap.parse_args()
+
+    record = asyncio.run(run_bench(args))
+    print(json.dumps(record))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        existing = []
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                existing = json.load(f)
+        existing.append(record)
+        with open(args.out, "w") as f:
+            json.dump(existing, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

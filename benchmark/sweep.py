@@ -17,6 +17,8 @@ import argparse
 import json
 import os
 
+from narwhal_tpu.config import Parameters
+
 from .local import BenchParameters, LocalBench
 from .logs import ParseError
 
@@ -34,7 +36,11 @@ def run_once(rate: int, args) -> dict:
             crypto_backend=args.crypto_backend,
             dag_backend=args.dag_backend,
             dag_shards=args.dag_shards,
-        )
+        ),
+        node_parameters=Parameters(
+            max_header_delay=args.max_header_delay,
+            max_batch_delay=args.max_batch_delay,
+        ),
     )
     parser = bench.run()
     record = parser.to_dict()
@@ -113,6 +119,8 @@ def main() -> None:
                     default="cpu")
     ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--dag-shards", type=int, default=1)
+    ap.add_argument("--max-header-delay", type=float, default=0.1)
+    ap.add_argument("--max-batch-delay", type=float, default=0.1)
     ap.add_argument("--rates", type=int, nargs="*", default=[5_000, 15_000, 30_000])
     ap.add_argument("--auto", action="store_true", help="geometric ramp to the knee")
     ap.add_argument("--start-rate", type=int, default=2_000)

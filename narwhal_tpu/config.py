@@ -331,27 +331,55 @@ class Shared:
 
 _HANDED_OUT: set[int] = set()
 _HANDED_ORDER: deque[int] = deque()
-# Recently-handed ports to avoid re-issuing before their server binds. A
-# bounded window: servers bind within moments of assignment, so only the
-# recent tail matters — an unbounded set would eventually exhaust the 64
-# bind attempts in a long-lived process that keeps building clusters.
+# Placeholder sockets keep every handed-out port BOUND (with SO_REUSEPORT)
+# until the real server co-binds: between assignment and bind the kernel
+# would otherwise happily hand the same port to an ephemeral *outbound*
+# connection — with a 20-node committee (~100 pre-assigned ports, thousands
+# of mesh dials) that collision is routine, and the server's bind then fails
+# with EADDRINUSE. Outbound sockets don't set SO_REUSEPORT so they can never
+# share a placeheld port; servers do (RpcServer reuse_port, gRPC's default),
+# so they bind straight through the placeholder.
+_PLACEHOLDERS: dict[int, socket.socket] = {}
+# Only the recent tail matters: servers bind within moments of assignment,
+# and an unbounded set would eventually exhaust the 64 bind attempts in a
+# long-lived process that keeps building clusters.
 _HANDED_WINDOW = 1024
 
 
 def get_available_port(host: str = "127.0.0.1") -> int:
     """(/root/reference/config/src/utils.rs:9-33). Ports are pre-assigned
-    before servers bind them, so remember what we handed out recently within
-    this process and never hand the same port twice in that window — the OS
-    allocator can cycle back to a port whose server has not bound yet."""
+    before servers bind them: hand out a port at most once per window and
+    keep it placeheld (see _PLACEHOLDERS) until its server binds."""
     for _ in range(64):
-        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
             s.bind((host, 0))
-            port = s.getsockname()[1]
-        if port not in _HANDED_OUT:
-            _HANDED_OUT.add(port)
-            _HANDED_ORDER.append(port)
-            while len(_HANDED_ORDER) > _HANDED_WINDOW:
-                _HANDED_OUT.discard(_HANDED_ORDER.popleft())
-            return port
+        except OSError:
+            s.close()
+            continue
+        port = s.getsockname()[1]
+        if port in _HANDED_OUT:
+            s.close()
+            continue
+        _HANDED_OUT.add(port)
+        _HANDED_ORDER.append(port)
+        _PLACEHOLDERS[port] = s
+        while len(_HANDED_ORDER) > _HANDED_WINDOW:
+            old = _HANDED_ORDER.popleft()
+            _HANDED_OUT.discard(old)
+            stale = _PLACEHOLDERS.pop(old, None)
+            if stale is not None:
+                stale.close()
+        return port
     raise OSError("no available port after 64 attempts")
+
+
+def release_port(port: int) -> None:
+    """Drop the placeholder for `port` once its real server has bound (or
+    will never bind). Safe to call for ports this process never placeheld —
+    a subprocess binding a parent-assigned port simply co-binds via
+    SO_REUSEPORT and the parent's placeholder dies with the parent."""
+    s = _PLACEHOLDERS.pop(port, None)
+    if s is not None:
+        s.close()

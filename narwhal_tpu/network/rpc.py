@@ -307,15 +307,28 @@ class RpcServer:
         # ephemeral outbound connection): retry briefly before giving up.
         for attempt in range(5):
             try:
+                # reuse_port lets the bind coexist with the allocator's
+                # SO_REUSEPORT placeholder (config.get_available_port), which
+                # reserves pre-assigned ports against ephemeral collisions;
+                # the placeholder never listens, so all connections land here.
                 self._server = await asyncio.start_server(
-                    self._on_connection, host, port, limit=MAX_FRAME + 1024
+                    self._on_connection, host, port, limit=MAX_FRAME + 1024,
+                    reuse_port=(port != 0),
                 )
                 break
             except OSError:
                 if attempt == 4:
                     raise
                 await asyncio.sleep(0.2 * (attempt + 1))
-        return self._server.sockets[0].getsockname()[1]
+        bound = self._server.sockets[0].getsockname()[1]
+        # The allocator's placeholder has done its job once we hold the
+        # listening socket; dropping it returns the fd (a long-lived
+        # process building many clusters would otherwise hold up to a
+        # window's worth of placeholder fds against the ulimit).
+        from ..config import release_port
+
+        release_port(bound)
+        return bound
 
     @property
     def port(self) -> int:

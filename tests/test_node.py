@@ -285,3 +285,30 @@ def test_cluster_with_sharded_tpu_dag_backend(run, tmp_path):
             await cluster.shutdown()
 
     run(scenario(), timeout=90.0)
+
+
+def test_twenty_node_committee_with_faults(run):
+    """Committee scaling (BASELINE configs #4-5 risk): a 20-node in-process
+    committee commits, and keeps committing after f=6 nodes die (the
+    remaining 14 hold a 2f+1 quorum). Exercises proposer fan-in, certificate
+    aggregation and window sizing at a committee size kernels can't see."""
+
+    async def scenario():
+        cluster = Cluster(size=20, workers=1)
+        await cluster.start()
+        try:
+            await cluster.assert_progress(commit_threshold=3, timeout=60.0)
+            for i in range(14, 20):
+                await cluster.stop_node(i)
+            before = min(
+                a.metric("consensus_last_committed_round")
+                for a in cluster.authorities
+                if a.primary is not None
+            )
+            await cluster.assert_progress(
+                expected_nodes=14, commit_threshold=int(before) + 4, timeout=60.0
+            )
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=150.0)
