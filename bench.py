@@ -121,17 +121,58 @@ def main() -> None:
         ts.sort()
         return ts[len(ts) // 2], ts[-1] - ts[0]
 
+    def chain_rate(make_fn, per_iter):
+        """Two-point differenced on-device iteration chain -> items/s."""
+        small_fn = make_fn(2)
+        t_small, noise_small = timed(small_fn, a_y, sign, dig)
+        for spread in (10, 30):  # widen if link noise swamps the delta
+            t_big, noise_big = timed(make_fn(2 + spread), a_y, sign, dig)
+            delta = t_big - t_small
+            # Sanity: the delta must stand clear of the observed timing
+            # noise (no assumption about absolute kernel speed).
+            if delta > 4 * max(noise_small, noise_big, 1e-3):
+                return spread * per_iter / delta
+        return None
+
+    item_rate = chain_rate(repeat_kernel, dev_b)
+
+    # The production batch path: one random-linear-combination accumulate
+    # per batch (msm_accumulate_kernel) — the shared doubling chain's
+    # amortization is the round-3 throughput multiple. The per-batch host
+    # Horner epilogue (~300 bigint point ops on the [4, 20, 64] readback)
+    # is timed separately: in the pipelined flow it overlaps the next
+    # batch's device compute, so steady state is bounded by max(device,
+    # epilogue), reported below as the effective rate.
+    z_dig = jnp.asarray(rng.integers(0, 16, (dev_b, 32), dtype=np.int32))
+
+    def repeat_msm(reps):
+        @jax.jit
+        def f(a_y, sign, dig):
+            def body(i, acc):
+                v, valid = kern.msm_accumulate_kernel(
+                    a_y, sign, a_y, sign, (dig + (i & 1)) & 15, z_dig
+                )
+                return acc + v[0, 0, 0] + jnp.sum(valid.astype(jnp.int32))
+            return lax.fori_loop(0, reps, body, jnp.int32(0))
+        return f
+
+    msm_accum_rate = chain_rate(repeat_msm, dev_b)
+
+    from narwhal_tpu.tpu.verifier import msm_epilogue_check
+
+    v_host = np.asarray(
+        kern.msm_accumulate_kernel(
+            np.asarray(a_y), np.asarray(sign), np.asarray(a_y), np.asarray(sign),
+            np.asarray(dig), np.asarray(z_dig),
+        )[0]
+    )
+    t0 = time.perf_counter()
+    for _ in range(5):
+        msm_epilogue_check(v_host, 12345, kern)
+    epi_dt = (time.perf_counter() - t0) / 5
     device_rate = None
-    small_fn = repeat_kernel(2)
-    t_small, noise_small = timed(small_fn, a_y, sign, dig)
-    for spread in (10, 30):  # widen the spread if link noise swamps the delta
-        t_big, noise_big = timed(repeat_kernel(2 + spread), a_y, sign, dig)
-        delta = t_big - t_small
-        # Sanity: the delta must stand clear of the observed timing noise
-        # (no assumption about absolute kernel speed).
-        if delta > 4 * max(noise_small, noise_big, 1e-3):
-            device_rate = spread * dev_b / delta
-            break
+    if msm_accum_rate:
+        device_rate = min(msm_accum_rate, dev_b / epi_dt)
 
     print(
         json.dumps(
@@ -144,10 +185,21 @@ def main() -> None:
                 "device_only_vs_baseline": (
                     round(device_rate / host_rate, 3) if device_rate else None
                 ),
+                "device_only_per_item_kernel_per_s": (
+                    round(item_rate, 1) if item_rate else None
+                ),
+                "msm_accumulate_per_s": (
+                    round(msm_accum_rate, 1) if msm_accum_rate else None
+                ),
+                "msm_host_epilogue_ms_per_batch": round(epi_dt * 1000, 2),
                 "host_per_s": round(host_rate, 1),
                 "note": "value = median pipelined e2e window incl. host packing "
                 "and tunneled transfers (link bandwidth drifts run to run); "
-                "device_only = stable on-chip rate at batch 8192",
+                "device_only = the production batch path's steady-state rate "
+                "min(device msm accumulate, host Horner epilogue) at batch "
+                "8192 (random-linear-combination check); "
+                "device_only_per_item_kernel = the per-item Straus kernel "
+                "(the fallback path, round 2's headline)",
             }
         )
     )

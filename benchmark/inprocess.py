@@ -45,7 +45,7 @@ async def run_bench(args) -> dict:
         consensus_protocol=args.consensus_protocol,
     )
     await cluster.start(args.nodes - args.faults)
-    await cluster.assert_progress(commit_threshold=2, timeout=120.0)
+    await cluster.assert_progress(commit_threshold=2, timeout=args.warmup_timeout)
 
     alive = args.nodes - args.faults
     executed = [0] * alive
@@ -152,6 +152,9 @@ def main() -> None:
     ap.add_argument("--tx-size", type=int, default=512)
     ap.add_argument("--duration", type=int, default=30)
     ap.add_argument("--drain-tail", type=float, default=5.0)
+    ap.add_argument("--warmup-timeout", type=float, default=120.0,
+                    help="boot-to-first-commits window (TPU backends pay a\n"
+                    "first-compile + tunnel-RTT warmup)")
     ap.add_argument("--faults", type=int, default=0)
     ap.add_argument("--consensus-protocol", choices=("bullshark", "tusk"),
                     default="bullshark")

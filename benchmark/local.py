@@ -28,6 +28,7 @@ from narwhal_tpu.config import (
     WorkerCache,
     WorkerInfo,
     get_available_port,
+    release_all_ports,
 )
 from narwhal_tpu.crypto import KeyPair
 
@@ -195,6 +196,9 @@ class LocalBench:
                     for wid in range(bench.workers)
                 ]
             )
+            # The children own the assigned ports now; free the parent's
+            # placeholder fds so long sweeps don't creep toward the ulimit.
+            release_all_ports()
             # One client per alive worker lane (local.py: rate share).
             lanes = [
                 workers[keys[i]][wid].transactions

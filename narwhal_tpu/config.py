@@ -349,22 +349,36 @@ _HANDED_WINDOW = 1024
 def get_available_port(host: str = "127.0.0.1") -> int:
     """(/root/reference/config/src/utils.rs:9-33). Ports are pre-assigned
     before servers bind them: hand out a port at most once per window and
-    keep it placeheld (see _PLACEHOLDERS) until its server binds."""
+    keep it placeheld (see _PLACEHOLDERS) until its server binds.
+
+    The probe binds WITHOUT SO_REUSEPORT — the kernel then never selects a
+    port owned by a live reuse-port listener (which a REUSEPORT probe would
+    happily co-bind, silently splitting that listener's traffic). The
+    placeholder then re-binds the probed port with SO_REUSEPORT so the real
+    server can bind through it; losing the tiny re-bind race just retries.
+    """
     for _ in range(64):
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
             s.bind((host, 0))
+            port = s.getsockname()[1]
         except OSError:
             s.close()
             continue
-        port = s.getsockname()[1]
+        s.close()
         if port in _HANDED_OUT:
-            s.close()
+            continue
+        ph = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ph.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        try:
+            ph.bind((host, port))
+        except OSError:
+            ph.close()  # an ephemeral connection won the re-bind race
             continue
         _HANDED_OUT.add(port)
         _HANDED_ORDER.append(port)
-        _PLACEHOLDERS[port] = s
+        _PLACEHOLDERS[port] = ph
         while len(_HANDED_ORDER) > _HANDED_WINDOW:
             old = _HANDED_ORDER.popleft()
             _HANDED_OUT.discard(old)
@@ -379,7 +393,17 @@ def release_port(port: int) -> None:
     """Drop the placeholder for `port` once its real server has bound (or
     will never bind). Safe to call for ports this process never placeheld —
     a subprocess binding a parent-assigned port simply co-binds via
-    SO_REUSEPORT and the parent's placeholder dies with the parent."""
+    SO_REUSEPORT and the parent releases via release_all_ports."""
     s = _PLACEHOLDERS.pop(port, None)
     if s is not None:
+        s.close()
+
+
+def release_all_ports() -> None:
+    """Drop every live placeholder. For multi-process harness parents: the
+    children bind the assigned ports themselves, so the parent must free
+    its placeholder fds once the fleet is up (a sweep would otherwise
+    accumulate them toward the fd ulimit)."""
+    while _PLACEHOLDERS:
+        _, s = _PLACEHOLDERS.popitem()
         s.close()

@@ -272,7 +272,12 @@ class BlockSynchronizer:
             lambda: defaultdict(list)
         )
         for cert in certificates:
-            targets = providers.get(cert.digest) or [cert.origin]
+            # The certificate author is always a last-resort provider: a
+            # peer that declares availability but never serves (a liar or a
+            # dead worker) must not monopolize the rotation.
+            targets = list(providers.get(cert.digest) or [])
+            if cert.origin not in targets:
+                targets.append(cert.origin)
             target = targets[attempt % len(targets)]
             for batch_digest, worker_id in cert.header.payload.items():
                 if not self.payload_store.contains(batch_digest, worker_id):
@@ -316,7 +321,9 @@ class BlockSynchronizer:
         await asyncio.gather(*(ask(p, a) for p, a in peers))
         if answers == 0:
             return []
-        threshold = max(1, int(answers * CERTIFICATE_RESPONSES_RATIO_THRESHOLD))
+        # Ceiling, not truncation: with 3 answers a digest needs 2 backers —
+        # int() would let a single (possibly lying) peer's digest through.
+        threshold = max(1, -(-answers * CERTIFICATE_RESPONSES_RATIO_THRESHOLD // 1))
         wanted = [
             d
             for d, n in counts.items()

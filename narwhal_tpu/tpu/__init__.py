@@ -1,0 +1,39 @@
+"""TPU offload kernels (crypto + DAG) and their host wrappers.
+
+The kernel modules (ed25519, dag_kernels) call `enable_compilation_cache`
+when THEY import — the package itself stays jax-free so that pure-host
+paths (the `pool` crypto backend, config/CLI imports) never pay the
+multi-second jax import. The cache (repo `.jax_cache/`, override with
+JAX_COMPILATION_CACHE_DIR) matters because the big kernels — the per-item
+ed25519 Straus walk, the batch MSM accumulate, the chain_commit scan —
+take minutes to compile uncached on slow hosts/tunnels, and every process
+(node, bench, pytest) should pay that once per machine, not once per run.
+"""
+
+from __future__ import annotations
+
+import os
+
+_cache_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    """Idempotent; requires jax to be importable (callers import it)."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"
+        ),
+    )
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _cache_enabled = True
+    except Exception:  # pragma: no cover - cache is an optimization only
+        pass

@@ -31,6 +31,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from . import enable_compilation_cache
+
+enable_compilation_cache()
+
 from ..config import Committee
 from ..stores import ConsensusStore
 from ..types import Certificate, ConsensusOutput, Digest, Round, SequenceNumber

@@ -35,14 +35,99 @@ _MIN_BUCKET = 16
 _MAX_BUCKET = 8192
 
 
-class TpuVerifier:
-    """Synchronous batch verifier backed by the JAX kernel."""
+def msm_epilogue_check(v_limbs: np.ndarray, sum_s: int, kernel) -> bool:
+    """Host half of the batch check: Horner-collapse the device's
+    per-window point sums and test [8]([Σ z_iS_i]B + Σ_w 16^(63-w) V_w)
+    == identity.
 
-    def __init__(self, max_bucket: int = _MAX_BUCKET):
+    v_limbs: int32[4, NLIMB, W] loose X/Y/Z/T limbs from
+    msm_accumulate_kernel (MSB-first window lanes). ~300 bigint point ops
+    (~2 ms), amortized over the whole batch; the device equivalent would be
+    sub-tile sequential work costing hundreds of ms.
+
+    COFACTORED (the [8]·): torsion components of adversarial A/R cancel
+    deterministically, so acceptance never depends on the random z_i — a
+    cofactorless batch would accept a torsion-defect signature with
+    probability 1/8 over z, making two honest verifiers of the SAME bytes
+    disagree at random (a consensus-splitting vector). This matches
+    ed25519-dalek's batch_verify semantics (RFC 8032 cofactored); the
+    strict per-item rule differs on such crafted inputs, so the msm
+    fallback re-checks strict rejects against the cofactored rule
+    (_cofactored_verify) to keep the whole tpu backend deterministic.
+    Committees must not mix cofactored (tpu) and cofactorless (cpu host
+    library) backends if adversarially-crafted torsion keys are a concern.
+    """
+    ref = kernel.ref
+    W = v_limbs.shape[2]
+    acc = (0, 1, 1, 0)  # identity, extended coordinates
+    for w in range(W):
+        for _ in range(4):
+            acc = ref.point_double(acc)
+        vw = tuple(
+            kernel.limbs_to_int(v_limbs[c, :, w]) % ref.P for c in range(4)
+        )
+        acc = ref.point_add(acc, vw)
+    acc = ref.point_add(acc, ref.point_mul(sum_s % ref.L, ref.G))
+    for _ in range(3):  # cofactor 8
+        acc = ref.point_double(acc)
+    # Identity ⇔ X ≡ 0 and Y ≡ Z (mod p).
+    return acc[0] % ref.P == 0 and (acc[1] - acc[2]) % ref.P == 0
+
+
+def _cofactored_verify(kernel, pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """Host cofactored single verification (RFC 8032 style):
+    [8]([S]B − [k]A − R) == identity. Used only on the rare msm-fallback
+    path for items the strict per-item kernel rejected, so the tpu
+    backend's accept set is deterministically the cofactored one."""
+    ref = kernel.ref
+    a = ref.decompress(pk)
+    r = ref.decompress(sig[:32])
+    if a is None or r is None:
+        return False
+    s_int = int.from_bytes(sig[32:], "little")
+    if s_int >= ref.L:
+        return False
+    k = ref.sha512_mod_l(sig[:32], pk, msg)
+    acc = ref.point_add(
+        ref.point_mul(s_int, ref.G),
+        ref.point_add(
+            ref.point_mul(k, ref.point_neg(a)), ref.point_neg(r)
+        ),
+    )
+    for _ in range(3):
+        acc = ref.point_double(acc)
+    return acc[0] % ref.P == 0 and (acc[1] - acc[2]) % ref.P == 0
+
+
+class TpuVerifier:
+    """Synchronous batch verifier backed by the JAX kernels.
+
+    mode="msm" (default): one random-linear-combination check per bucket —
+    [Σ z_iS_i]B − Σ[z_ik_i]A_i − Σ[z_i]R_i == 0 with fresh 128-bit z_i —
+    sharing a single doubling chain across the whole bucket (~2x the
+    per-item kernel's throughput). A failed bucket (any bad or malformed
+    signature) falls back to the per-item kernel to locate offenders, so
+    adversarial input degrades one bucket to ~old cost, never correctness.
+    mode="item": always the per-item Straus kernel.
+    """
+
+    def __init__(
+        self,
+        max_bucket: int = _MAX_BUCKET,
+        mode: str | None = None,
+        msm_min_bucket: int = 512,
+    ):
+        import os
+
         from . import ed25519 as kernel  # deferred: imports jax
 
         self.kernel = kernel
         self.max_bucket = max_bucket
+        self.mode = mode or os.environ.get("NARWHAL_TPU_VERIFY_MODE", "msm")
+        # Small buckets stay on the per-item kernel: they're the latency
+        # path, the msm advantage is amortization, and each extra bucket
+        # shape costs a multi-minute first compile.
+        self.msm_min_bucket = msm_min_bucket
 
     def precompile(self, sizes: Sequence[int] = ()) -> None:
         """Warm the jit cache for the given bucket sizes."""
@@ -60,12 +145,14 @@ class TpuVerifier:
         latency overlaps the next batch's host packing and compute."""
         n = len(items)
         if n == 0:
-            return (np.zeros(0, bool), np.zeros(0, np.int64), [])
+            return (np.zeros(0, bool), np.zeros(0, np.int64), [], None, items)
         ok = np.zeros(n, bool)
         a_raw = np.zeros((n, 32), np.uint8)
         r_raw = np.zeros((n, 32), np.uint8)
         s_raw = np.zeros((n, 32), np.uint8)
         k_raw = np.zeros((n, 32), np.uint8)
+        k_ints = [0] * n
+        s_ints = [0] * n
         precheck = np.zeros(n, bool)
         for i, (pk, msg, sig) in enumerate(items):
             if len(pk) != 32 or len(sig) != 64:
@@ -85,11 +172,13 @@ class TpuVerifier:
             r_raw[i] = np.frombuffer(rs, np.uint8)
             s_raw[i] = np.frombuffer(sb, np.uint8)
             k_raw[i] = np.frombuffer(k_int.to_bytes(32, "little"), np.uint8)
+            k_ints[i] = k_int
+            s_ints[i] = s_int
             precheck[i] = True
 
         idx = np.flatnonzero(precheck)
         if idx.size == 0:
-            return (ok, idx, [])
+            return (ok, idx, [], None, items)
 
         # Narrow upload dtypes (limbs < 2^13, digits < 16): ~3x fewer bytes
         # over the device link; the kernel widens to int32 lanes on device.
@@ -99,8 +188,9 @@ class TpuVerifier:
         r_sign = (r_raw[idx, 31] >> 7).astype(np.int8)
         k_digits = self.kernel.bytes_to_digits(k_raw[idx]).astype(np.int8)
         s_digits = self.kernel.bytes_to_digits(s_raw[idx]).astype(np.int8)
+        packed = (a_y, a_sign, r_y, r_sign, k_digits, s_digits)
 
-        outs = []  # (lo, hi, device array)
+        outs = []  # (kind, lo, hi, device out)
         for lo in range(0, idx.size, self.max_bucket):
             hi = min(lo + self.max_bucket, idx.size)
             bucket = _MIN_BUCKET
@@ -108,39 +198,109 @@ class TpuVerifier:
                 bucket *= 2
             pad = bucket - (hi - lo)
 
-            def pad_to(arr):
-                if pad == 0:
-                    return arr[lo:hi]
-                return np.concatenate(
-                    [arr[lo:hi], np.repeat(arr[lo : lo + 1], pad, axis=0)]
+            if self.mode == "msm" and bucket >= self.msm_min_bucket:
+                out = self._dispatch_msm(
+                    packed, idx, k_ints, s_ints, lo, hi, pad
                 )
-
-            out = self.kernel.verify_batch_kernel(
-                pad_to(a_y),
-                pad_to(a_sign),
-                pad_to(r_y),
-                pad_to(r_sign),
-                pad_to(k_digits),
-                pad_to(s_digits),
-            )
+                kind = "msm"
+                arrays = out[0]  # ((V, valid), sum_s)
+            else:
+                out = self._dispatch_items(packed, lo, hi, pad)
+                kind = "item"
+                arrays = (out,)
             # Kick off the device->host copy as soon as the kernel finishes
             # so collect() finds the bytes already local instead of paying
             # the transfer round trip synchronously.
-            try:
-                out.copy_to_host_async()
-            except AttributeError:
-                pass
-            outs.append((lo, hi, out))
-        return (ok, idx, outs)
+            for arr in arrays:
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+            outs.append((kind, lo, hi, pad, out))
+        return (ok, idx, outs, packed, items)
 
-    @staticmethod
-    def collect(handle) -> list[bool]:
-        """Materialize a `submit` handle's results (blocks on the device)."""
-        ok, idx, outs = handle
+    def _dispatch_items(self, packed, lo, hi, pad):
+        """Per-item Straus kernel over one padded bucket."""
+
+        def pad_to(arr):
+            if pad == 0:
+                return arr[lo:hi]
+            return np.concatenate(
+                [arr[lo:hi], np.repeat(arr[lo : lo + 1], pad, axis=0)]
+            )
+
+        return self.kernel.verify_batch_kernel(*(pad_to(a) for a in packed))
+
+    def _dispatch_msm(self, packed, idx, k_ints, s_ints, lo, hi, pad):
+        """Random-linear-combination check over one bucket. Fresh 128-bit
+        z_i per item per call (os.urandom — the adversary must not predict
+        them); zero rows are inert padding. Host bignum work is ~3 modmuls
+        per item on Python ints. Returns (device (V, valid), sum_s) — the
+        Horner/identity epilogue runs on host at collect time."""
+        import os as _os
+
+        L = self.kernel.ref.L
+        m = hi - lo
+        rnd = _os.urandom(16 * m)
+        zs = [int.from_bytes(rnd[16 * t : 16 * (t + 1)], "little") for t in range(m)]
+        ak_raw = np.zeros((m + pad, 32), np.uint8)
+        z_raw = np.zeros((m + pad, 32), np.uint8)
+        sum_s = 0
+        for t in range(m):
+            j = int(idx[lo + t])
+            ak_raw[t] = np.frombuffer(
+                ((zs[t] * k_ints[j]) % L).to_bytes(32, "little"), np.uint8
+            )
+            z_raw[t, :16] = np.frombuffer(zs[t].to_bytes(16, "little"), np.uint8)
+            sum_s += zs[t] * s_ints[j]
+
+        ak_digits = self.kernel.bytes_to_digits(ak_raw).astype(np.int8)
+        # z < 2^128: the MSB-first digit vector's low half carries it.
+        z_digits = self.kernel.bytes_to_digits(z_raw)[:, 32:].astype(np.int8)
+
+        def zpad(arr):
+            if pad == 0:
+                return arr[lo:hi]
+            return np.concatenate(
+                [arr[lo:hi], np.zeros((pad,) + arr.shape[1:], arr.dtype)]
+            )
+
+        a_y, a_sign, r_y, r_sign, _, _ = packed
+        out = self.kernel.msm_accumulate_kernel(
+            zpad(a_y), zpad(a_sign), zpad(r_y), zpad(r_sign),
+            ak_digits, z_digits,
+        )
+        return (out, sum_s % L)
+
+    def collect(self, handle) -> list[bool]:
+        """Materialize a `submit` handle's results (blocks on the device).
+        A failed msm bucket re-dispatches the per-item kernel to locate the
+        offending signatures (rare path: only adversarial/corrupt input);
+        strict-kernel rejects are then re-checked against the cofactored
+        rule so the msm mode's accept set stays deterministic."""
+        ok, idx, outs, packed, items = handle
         if idx.size:
             results = np.zeros(idx.size, bool)
-            for lo, hi, out in outs:
-                results[lo:hi] = np.asarray(out)[: hi - lo]
+            for kind, lo, hi, pad, out in outs:
+                if kind == "item":
+                    results[lo:hi] = np.asarray(out)[: hi - lo]
+                    continue
+                (v_dev, valid_dev), sum_s = out
+                valid = np.asarray(valid_dev)
+                if bool(valid.all()) and msm_epilogue_check(
+                    np.asarray(v_dev), sum_s, self.kernel
+                ):
+                    results[lo:hi] = True
+                else:
+                    fallback = np.asarray(
+                        self._dispatch_items(packed, lo, hi, pad)
+                    )[: hi - lo].copy()
+                    for t in np.flatnonzero(~fallback):
+                        pk, msg, sig = items[int(idx[lo + int(t)])]
+                        fallback[int(t)] = _cofactored_verify(
+                            self.kernel, pk, msg, sig
+                        )
+                    results[lo:hi] = fallback
             ok[idx] = results
         return ok.tolist()
 

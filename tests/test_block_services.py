@@ -186,3 +186,280 @@ def test_payload_sync_rotates_providers(run):
             await worker_srv.stop()
 
     run(scenario())
+
+
+async def _mock_range_peer(f, index, range_digests, certs_by_digest):
+    """A scripted peer that advertises `range_digests` for any range request
+    and serves `certs_by_digest` (possibly tampered/wrong) on batch fetch."""
+    from narwhal_tpu.messages import (
+        CertificatesRangeRequest,
+        CertificatesRangeResponse,
+    )
+
+    srv = RpcServer()
+
+    async def on_range(msg: CertificatesRangeRequest, peer):
+        return CertificatesRangeResponse(tuple(range_digests))
+
+    async def on_batch(msg, peer):
+        from narwhal_tpu.messages import CertificatesBatchResponse
+
+        return CertificatesBatchResponse(
+            tuple((d, certs_by_digest.get(d)) for d in msg.digests)
+        )
+
+    from narwhal_tpu.messages import CertificatesBatchRequest as _CBR
+
+    srv.route(CertificatesRangeRequest, on_range)
+    srv.route(_CBR, on_batch)
+    port = await srv.start("127.0.0.1", 0)
+    pk = f.authorities[index].public
+    auth = f.committee.authorities[pk]
+    f.committee.authorities[pk] = Authority(
+        auth.stake, f"127.0.0.1:{port}", auth.network_key
+    )
+    return srv
+
+
+def _tampered(cert):
+    """Certificate with one vote signature corrupted (quorum intact on
+    paper, cryptographically invalid)."""
+    from dataclasses import replace as dc_replace
+
+    sigs = list(cert.signatures)
+    sigs[0] = bytes(64)
+    return dc_replace(cert, signatures=tuple(sigs))
+
+
+def test_fetched_certificate_with_bad_signature_is_rejected(run):
+    """A peer serving a certificate whose vote signature is corrupt: the
+    fetch must neither return it nor loop it back to the Core
+    (handler.rs re-injects only VERIFIED certificates)."""
+
+    async def scenario():
+        from narwhal_tpu.channels import Channel
+
+        f = CommitteeFixture(size=4, workers=1)
+        cert = f.certificate(f.header(author=1, round=1))
+        bad = _tampered(cert)
+        servers = [
+            await _mock_peer_primary(f, 1, {cert.digest: bad}),
+            await _mock_peer_primary(f, 2, {cert.digest: bad}),
+            await _mock_peer_primary(f, 3, {cert.digest: bad}),
+        ]
+        loopback = Channel(10)
+        sync, storage = _make_sync(f, tx_loopback=loopback)
+        try:
+            got = await sync.synchronize_block_headers([cert.digest], timeout=3.0)
+            assert got == []
+            assert loopback.empty()
+            assert not storage.certificate_store.contains(cert.digest)
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_peer_answering_with_wrong_certificates_is_ignored(run):
+    """A peer that answers the batch request with certificates for OTHER
+    digests (a misbehaving or confused peer) contributes nothing; the
+    honest peer's certificate is still collected."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        wanted = f.certificate(f.header(author=1, round=1))
+        decoy = f.certificate(f.header(author=2, round=1))
+
+        srv_lies = RpcServer()
+
+        async def on_batch_lies(msg: CertificatesBatchRequest, peer):
+            return CertificatesBatchResponse(
+                tuple((d, decoy) for d in msg.digests)
+            )
+
+        srv_lies.route(CertificatesBatchRequest, on_batch_lies)
+        port = await srv_lies.start("127.0.0.1", 0)
+        pk = f.authorities[1].public
+        auth = f.committee.authorities[pk]
+        f.committee.authorities[pk] = Authority(
+            auth.stake, f"127.0.0.1:{port}", auth.network_key
+        )
+        servers = [
+            srv_lies,
+            await _mock_peer_primary(f, 2, {wanted.digest: wanted}),
+            await _mock_peer_primary(f, 3, {}),
+        ]
+        sync, _ = _make_sync(f)
+        try:
+            got = await sync.synchronize_block_headers([wanted.digest], timeout=5.0)
+            assert [c.digest for c in got] == [wanted.digest]
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_range_sync_with_empty_peer_responses(run):
+    """Every peer answers the range request with an empty digest list: the
+    catch-up returns promptly with nothing to fetch (no error, no hang)."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        servers = [await _mock_range_peer(f, i, [], {}) for i in (1, 2, 3)]
+        sync, _ = _make_sync(f)
+        try:
+            got = await asyncio.wait_for(
+                sync.synchronize_range(0, timeout=2.0), 5.0
+            )
+            assert got == []
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_range_sync_threshold_excludes_minority_digests(run):
+    """A digest advertised by only one of three answering peers falls below
+    the 0.5 response-ratio threshold (mod.rs:58) and is not fetched; a
+    digest advertised by all is."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        majority = f.certificate(f.header(author=1, round=1))
+        minority = f.certificate(f.header(author=2, round=1))
+        certs = {majority.digest: majority, minority.digest: minority}
+        servers = [
+            await _mock_range_peer(f, 1, [majority.digest, minority.digest], certs),
+            await _mock_range_peer(f, 2, [majority.digest], certs),
+            await _mock_range_peer(f, 3, [majority.digest], certs),
+        ]
+        from narwhal_tpu.channels import Channel
+
+        loopback = Channel(10)
+        sync, storage = _make_sync(f, tx_loopback=loopback)
+        try:
+            wanted = await sync.synchronize_range(0, timeout=3.0)
+            assert majority.digest in wanted
+            assert minority.digest not in wanted
+            # The fetched majority certificate is handed to the Core for
+            # storage/causal completion; the minority one never is.
+            injected = await asyncio.wait_for(loopback.recv(), 2.0)
+            assert injected.digest == majority.digest
+            assert loopback.empty()
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_range_sync_with_malformed_certificates(run):
+    """Peers advertise a digest but serve a cryptographically invalid
+    certificate for it: the catch-up completes without storing or
+    loopback-injecting the garbage."""
+
+    async def scenario():
+        from narwhal_tpu.channels import Channel
+
+        f = CommitteeFixture(size=4, workers=1)
+        cert = f.certificate(f.header(author=1, round=1))
+        bad = _tampered(cert)
+        servers = [
+            await _mock_range_peer(f, i, [cert.digest], {cert.digest: bad})
+            for i in (1, 2, 3)
+        ]
+        loopback = Channel(10)
+        sync, storage = _make_sync(f, tx_loopback=loopback)
+        try:
+            await asyncio.wait_for(sync.synchronize_range(0, timeout=2.0), 10.0)
+            assert loopback.empty()
+            assert not storage.certificate_store.contains(cert.digest)
+        finally:
+            sync.network.close()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())
+
+
+def test_range_sync_all_peers_dead(run):
+    """No peer reachable: range catch-up returns [] promptly instead of
+    hanging the restart path."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        for i in (1, 2, 3):
+            pk = f.authorities[i].public
+            auth = f.committee.authorities[pk]
+            f.committee.authorities[pk] = Authority(
+                auth.stake, "127.0.0.1:1", auth.network_key
+            )
+        sync, _ = _make_sync(f)
+        try:
+            t0 = asyncio.get_event_loop().time()
+            got = await asyncio.wait_for(
+                sync.synchronize_range(0, timeout=2.0), 10.0
+            )
+            assert got == []
+            assert asyncio.get_event_loop().time() - t0 < 5.0
+        finally:
+            sync.network.close()
+
+    run(scenario())
+
+
+def test_payload_availability_liar_fails_over_to_author(run):
+    """A peer declares payload availability but its workers never deliver:
+    the rotation falls back to other targets (including the certificate
+    author) instead of hammering the liar forever. We assert the worker
+    receives Synchronize commands naming DIFFERENT targets across retries."""
+
+    async def scenario():
+        from dataclasses import replace
+
+        f = CommitteeFixture(size=4, workers=1)
+        batch_digest = b"\x07" * 32
+        cert = f.certificate(
+            f.header(author=1, round=1, payload={batch_digest: 0})
+        )
+        liar_pk = f.authorities[2].public
+        servers = [
+            await _mock_peer_primary(f, 2, {}, available=(cert.digest,)),
+        ]
+        # Our own worker 0: capture Synchronize targets.
+        targets = []
+        wsrv = RpcServer()
+
+        async def on_sync(msg: SynchronizeMsg, peer):
+            targets.append(msg.target)
+
+        wsrv.route(SynchronizeMsg, on_sync)
+        wport = await wsrv.start("127.0.0.1", 0)
+        me = f.authorities[0].public
+        info = f.worker_cache.workers[me][0]
+        f.worker_cache.workers[me][0] = WorkerInfo(
+            name=info.name, transactions=info.transactions,
+            worker_address=f"127.0.0.1:{wport}",
+        )
+        sync, _ = _make_sync(f)
+        sync.parameters = replace(sync.parameters, sync_retry_delay=0.2)
+        try:
+            got = await sync.synchronize_block_payloads([cert], timeout=1.5)
+            assert got == []  # nothing ever arrives
+            assert len(targets) >= 2
+            assert len(set(targets)) >= 2, f"never rotated: {targets}"
+            assert liar_pk in targets  # the declared provider was tried
+        finally:
+            sync.network.close()
+            await wsrv.stop()
+            for s in servers:
+                await s.stop()
+
+    run(scenario())

@@ -111,3 +111,129 @@ def test_worker_scale_out(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=90.0)
+
+
+def test_partial_committee_change(run):
+    """Epoch change to a committee where one authority is REPLACED by a
+    fresh identity whose node never starts (epoch_change.rs
+    partial committee change): the three surviving members still hold
+    2f+1 stake and must keep producing certificates in the new epoch."""
+
+    async def scenario():
+        import json
+
+        from narwhal_tpu.crypto import KeyPair
+        from narwhal_tpu.network import Credentials, committee_resolver
+
+        cluster = Cluster(size=4, workers=1)
+        await cluster.start()
+        clients = [
+            NetworkClient(
+                credentials=Credentials(
+                    fixture_auth.worker_keypairs[0],
+                    committee_resolver(
+                        lambda: cluster.committee, lambda: cluster.worker_cache
+                    ),
+                )
+            )
+            for fixture_auth in cluster.fixture.authorities
+        ]
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=30.0)
+            # Replace authority 3 with a brand-new identity (no node runs
+            # for it) and advance the epoch.
+            doc = json.loads(cluster.committee.to_json())
+            old_pk = cluster.fixture.authorities[3].public.hex()
+            entry = doc["authorities"].pop(old_pk)
+            newcomer = KeyPair.generate()
+            newcomer_net = KeyPair.generate()
+            entry["network_key"] = newcomer_net.public.hex()
+            doc["authorities"][newcomer.public.hex()] = entry
+            doc["epoch"] = 1
+            msg = ReconfigureMsg("new_epoch", json.dumps(doc))
+            # Deliver to the three surviving primaries (the replaced node
+            # is no longer in the new committee).
+            for a, client in zip(cluster.authorities[:3], clients[:3]):
+                assert await client.unreliable_send(a.primary.address, msg)
+            await cluster.stop_node(3)
+            await _wait_epoch_progress(cluster, 1, 4, timeout=45.0)
+        finally:
+            for client in clients:
+                client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=120.0)
+
+
+def test_restart_into_new_committee_via_node_restarter(run):
+    """NodeRestarter-driven epoch change (node/tests/reconfigure.rs,
+    restarter.rs): every primary is torn down and respawned against the
+    epoch-1 committee (fresh addresses, fresh per-epoch store) and the new
+    committee commits from genesis."""
+
+    async def scenario():
+        from dataclasses import replace
+
+        from narwhal_tpu.config import Authority, get_available_port
+        from narwhal_tpu.fixtures import CommitteeFixture
+        from narwhal_tpu.node import NodeRestarter
+
+        f = CommitteeFixture(size=4, workers=1)
+        params = replace(f.parameters, max_header_delay=0.05)
+        committee0 = f.committee
+        for pk, auth in committee0.authorities.items():
+            committee0.authorities[pk] = replace(
+                auth, primary_address=f"127.0.0.1:{get_available_port()}"
+            )
+        restarters = [
+            NodeRestarter(
+                a.keypair, f.worker_cache, params,
+                network_keypair=a.network_keypair,
+            )
+            for a in f.authorities
+        ]
+
+        async def wait_commits(nodes, threshold, timeout=45.0):
+            deadline = asyncio.get_event_loop().time() + timeout
+            while True:
+                rounds = [
+                    n.registry.value("consensus_last_committed_round")
+                    for n in nodes
+                ]
+                if all(r >= threshold for r in rounds):
+                    return
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(f"no commits: {rounds}")
+                await asyncio.sleep(0.1)
+
+        nodes = []
+        try:
+            for r in restarters:
+                nodes.append(await r.start(committee0))
+            await wait_commits(nodes, 2)
+
+            # Epoch 1: same identities, fresh addresses, epoch bumped.
+            from narwhal_tpu.config import Committee
+
+            committee1 = Committee(
+                {
+                    pk: replace(
+                        auth, primary_address=f"127.0.0.1:{get_available_port()}"
+                    )
+                    for pk, auth in committee0.authorities.items()
+                },
+                epoch=1,
+            )
+            nodes = []
+            for r in restarters:
+                nodes.append(await r.restart(committee1))
+            await wait_commits(nodes, 2)
+            # The new epoch's certificates really are epoch-1.
+            store = nodes[0].storage.certificate_store
+            assert any(c.epoch == 1 for c in store.after_round(1))
+        finally:
+            for r in restarters:
+                if r.node is not None:
+                    await r.node.shutdown()
+
+    run(scenario(), timeout=150.0)

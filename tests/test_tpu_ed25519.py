@@ -138,3 +138,115 @@ def test_async_pool_coalesces():
 
     asyncio.run(scenario())
     assert calls[0] == 8  # first batch flushed by size, not per item
+
+
+# -- random-linear-combination batch mode (msm_verify_kernel) ---------------
+
+
+@pytest.fixture(scope="module")
+def msm_verifier():
+    # msm_min_bucket lowered so the small test batches exercise the msm
+    # path; production keeps small buckets on the per-item kernel.
+    return TpuVerifier(max_bucket=16, msm_min_bucket=16, mode="msm")
+
+
+def _items(n, tag=0):
+    kps = [KeyPair.generate() for _ in range(min(n, 5))]
+    out = []
+    for i in range(n):
+        kp = kps[i % len(kps)]
+        msg = bytes([tag, i]) * 10
+        out.append((kp.public, msg, kp.sign(msg)))
+    return out
+
+
+def test_msm_valid_batch_passes(msm_verifier):
+    items = _items(16)
+    assert msm_verifier(items) == [True] * 16
+
+
+def test_msm_corrupted_signature_isolated(msm_verifier):
+    """A failed batch falls back to the per-item kernel and flags exactly
+    the corrupted signature."""
+    items = _items(16, tag=1)
+    pk, msg, sig = items[7]
+    items[7] = (pk, msg, sig[:10] + bytes([sig[10] ^ 1]) + sig[11:])
+    assert msm_verifier(items) == [True] * 7 + [False] + [True] * 8
+
+
+def test_msm_wrong_message_isolated(msm_verifier):
+    items = _items(16, tag=2)
+    items[3] = (items[3][0], b"different", items[3][2])
+    assert msm_verifier(items) == [True] * 3 + [False] + [True] * 12
+
+
+def test_msm_malformed_inputs_excluded(msm_verifier):
+    from narwhal_tpu.tpu import ed25519 as kernel
+
+    items = _items(16, tag=3)
+    items[0] = (b"\x01" * 31, b"x", b"\x02" * 64)  # short key
+    items[1] = (
+        items[1][0],
+        items[1][1],
+        items[1][2][:32] + (kernel.ref.L + 1).to_bytes(32, "little"),  # S >= L
+    )
+    assert msm_verifier(items) == [False, False] + [True] * 14
+
+
+def test_msm_padding_is_inert(msm_verifier):
+    """9 items pad to a 16-bucket with zero rows; zero z makes them
+    identity terms, so the batch still passes."""
+    assert msm_verifier(_items(9, tag=4)) == [True] * 9
+
+
+def test_small_buckets_stay_on_item_kernel():
+    v = TpuVerifier(max_bucket=16, msm_min_bucket=512)
+    handle = v.submit(_items(4, tag=5))
+    kinds = [entry[0] for entry in handle[2]]
+    assert kinds == ["item"]
+    assert v.collect(handle) == [True] * 4
+
+
+def test_msm_torsion_defect_is_deterministic(msm_verifier):
+    """A signature under a torsion-carrying public key (A' = A + T, T of
+    small order) is where cofactored and strict verification disagree. The
+    msm mode must be DETERMINISTIC — cofactored, like ed25519-dalek's
+    batch_verify — never a coin flip over the random z_i (which would let
+    two honest verifiers of the same bytes disagree)."""
+    import os
+
+    from narwhal_tpu.tpu import ed25519 as kernel
+
+    ref = kernel.ref
+    # A small-order (torsion) point: [L]P for random P, non-identity.
+    while True:
+        y = int.from_bytes(os.urandom(32), "little") % ref.P
+        x = ref.recover_x(y, 0)
+        if x is None:
+            continue
+        p0 = (x, y, 1, x * y % ref.P)
+        t = ref.point_mul(ref.L, p0)
+        if t[0] % ref.P != 0 or (t[1] - t[2]) % ref.P != 0:
+            break
+    # Raw-scalar keypair, torsion-shifted public key, hand-crafted sig:
+    # S'B - k'A' - R = -k'T (pure torsion residual).
+    while True:
+        a_scalar = int.from_bytes(os.urandom(32), "little") % ref.L
+        a_point = ref.point_mul(a_scalar, ref.G)
+        pk_t = ref.compress(ref.point_add(a_point, t))
+        msg = b"torsion probe"
+        r_scalar = int.from_bytes(os.urandom(32), "little") % ref.L
+        r_bytes = ref.compress(ref.point_mul(r_scalar, ref.G))
+        k = ref.sha512_mod_l(r_bytes, pk_t, msg)
+        if k % 8 != 0:  # ensure the torsion residual is non-zero
+            break
+    s = (r_scalar + k * a_scalar) % ref.L
+    sig = r_bytes + s.to_bytes(32, "little")
+    assert not ref.verify(pk_t, msg, sig)  # strict (cofactorless) rejects
+
+    items = _items(15, tag=9) + [(pk_t, msg, sig)]
+    results = [msm_verifier(items) for _ in range(4)]
+    # Deterministic across independent random z draws, and cofactored:
+    # the torsion-defect signature is uniformly ACCEPTED.
+    assert all(r == results[0] for r in results)
+    assert results[0] == [True] * 16
