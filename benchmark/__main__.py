@@ -29,6 +29,8 @@ def main() -> None:
     ap.add_argument("--max-header-delay", type=float, default=0.1,
                     help="proposer timer (s); slow it on core-starved hosts")
     ap.add_argument("--max-batch-delay", type=float, default=0.1)
+    ap.add_argument("--mem-profiling", action="store_true",
+                    help="tracemalloc dumps per node into .bench/")
     args = ap.parse_args()
 
     bench = LocalBench(
@@ -43,6 +45,7 @@ def main() -> None:
             crypto_backend=args.crypto_backend,
             dag_backend=args.dag_backend,
             dag_shards=args.dag_shards,
+            mem_profiling=args.mem_profiling,
         ),
         node_parameters=Parameters(
             max_header_delay=args.max_header_delay,

@@ -47,6 +47,7 @@ class BenchParameters:
     crypto_backend: str = "cpu"  # | pool | tpu
     dag_backend: str = "cpu"  # | tpu
     dag_shards: int = 1  # committee-axis device shards (tpu backend)
+    mem_profiling: bool = False  # reference mem_profiling bench param
 
 
 class LocalBench:
@@ -113,6 +114,8 @@ class LocalBench:
             # tunneled chip and stall in client init. An explicit cpu
             # request means virtual/CPU devices: keep the plugin out.
             env.pop("PALLAS_AXON_POOL_IPS", None)
+        if self.bench.mem_profiling:
+            env["NARWHAL_MEM_PROFILE"] = self.base
         self.procs.append(
             subprocess.Popen(
                 [sys.executable, "-m", "narwhal_tpu", "-v", *argv],

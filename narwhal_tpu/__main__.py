@@ -173,6 +173,11 @@ def main(argv: list[str] | None = None) -> None:
     r.add_argument("--workers", required=True)
     r.add_argument("--parameters", default=None)
     r.add_argument("--store", default=None)
+    r.add_argument(
+        "--mem-profiling", action="store_true",
+        help="tracemalloc heap profiling (dhat analog): dumps "
+        "memprofile-<role>-<pid>.txt to the store dir on exit",
+    )
     rsub = r.add_subparsers(dest="role", required=True)
     p = rsub.add_parser("primary")
     p.add_argument(
@@ -234,6 +239,33 @@ def main(argv: list[str] | None = None) -> None:
         # SIGTERM, so convert it into a normal interpreter exit.
         import signal as _signal
 
+        _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(0))
+    # NARWHAL_MEM_PROFILE=<dir> (or --mem-profiling on `run`): tracemalloc
+    # sampling — the reference's dhat heap profiling analog
+    # (node/src/lib.rs:224-238, `mem_profiling` bench param). Periodic
+    # top-allocation log lines plus a final per-process dump file.
+    mem_dir = os.environ.get("NARWHAL_MEM_PROFILE") or (
+        getattr(args, "mem_profiling", None) and (args.store or ".")
+    )
+    if mem_dir:
+        import atexit
+        import signal as _signal
+        import tracemalloc
+
+        tracemalloc.start(10)
+        role = getattr(args, "role", args.command)
+        mem_out = os.path.join(mem_dir, f"memprofile-{role}-{os.getpid()}.txt")
+
+        def _dump_mem():
+            snap = tracemalloc.take_snapshot()
+            os.makedirs(os.path.dirname(mem_out) or ".", exist_ok=True)
+            with open(mem_out, "w") as fh:
+                current, peak = tracemalloc.get_traced_memory()
+                fh.write(f"current={current} peak={peak}\n")
+                for stat in snap.statistics("lineno")[:40]:
+                    fh.write(f"{stat}\n")
+
+        atexit.register(_dump_mem)
         _signal.signal(_signal.SIGTERM, lambda *_: sys.exit(0))
     if args.command == "generate_keys":
         cmd_generate_keys(args)

@@ -122,6 +122,11 @@ def chain_commit(parent, present, gc_depth, lc_rel, lcr_rel, offs, onehots):
     return masks
 
 
+# (W, N, auth-shards) chain_commit shapes already queued for background
+# compilation in this process (prewarm dedupe across engine instances).
+_PREWARMED_SHAPES: set[tuple[int, int, int]] = set()
+
+
 class DagWindow:
     """Host-managed ring of the last W rounds as dense arrays, with the
     digest <-> (round, authority) maps the tensors can't hold. This is the
@@ -244,6 +249,7 @@ class TpuBullshark:
         leader_fn=None,
         window: int | None = None,
         mesh=None,
+        prewarm: bool = True,
     ):
         self.committee = committee
         self.store = store
@@ -255,6 +261,53 @@ class TpuBullshark:
             pad_authorities_to=self._pad_for(committee),
         )
         self._chain_commit = self._build_dispatch()
+        self._prewarm_enabled = prewarm
+        self._prewarm_threads: list = []
+        if prewarm:
+            # Compile the NEXT window size ahead of need: _grow() doubles W
+            # mid-stream precisely when the node is already behind on
+            # commits, and an uncached XLA compile there stalls the commit
+            # path for seconds-to-minutes. The background compile writes
+            # the persistent compilation cache, so the post-growth dispatch
+            # is a (fast) cache deserialization instead of a compile.
+            self._prewarm(self.win.W * 2)
+
+    @property
+    def _warmed(self):
+        return _PREWARMED_SHAPES
+
+    def _prewarm(self, W: int) -> None:
+        # Deduped process-wide: 20 in-process engines must not spawn 20
+        # concurrent compiles of the identical shape.
+        key = (W, self.win.N, self.mesh.shape["auth"] if self.mesh else 0)
+        if key in _PREWARMED_SHAPES:
+            return
+        _PREWARMED_SHAPES.add(key)
+        import threading
+
+        def compile_ahead():
+            try:
+                N = self.win.N
+                for kpad in (1, 2):  # steady state + first catch-up bucket
+                    self._chain_commit.lower(
+                        np.zeros((W, N, N), np.uint8),
+                        np.zeros((W, N), np.uint8),
+                        np.int32(0),
+                        np.zeros((N,), np.int32),
+                        np.int32(-1),
+                        np.zeros((kpad,), np.int32),
+                        np.zeros((kpad, N), np.uint8),
+                    ).compile()
+            except Exception:  # pragma: no cover - warmup is best-effort
+                import logging
+
+                logging.getLogger("narwhal.tpu").debug(
+                    "window prewarm failed", exc_info=True
+                )
+
+        t = threading.Thread(target=compile_ahead, daemon=True)
+        t.start()
+        self._prewarm_threads.append(t)
 
     def _pad_for(self, committee: Committee) -> int | None:
         """Committee-axis width the mesh requires: the next multiple of the
@@ -400,6 +453,9 @@ class TpuBullshark:
             raise RuntimeError(
                 f"round {round} outside DAG window (base {self.win.round_base}, W {self.win.W})"
             )
+        if self._prewarm_enabled:
+            # Keep one doubling ahead of the current window size.
+            self._prewarm(self.win.W * 2)
         coords = self._commit_coords(round)
         if coords is None:
             return None

@@ -281,9 +281,35 @@ class TpuVerifier:
         ok, idx, outs, packed, items = handle
         if idx.size:
             results = np.zeros(idx.size, bool)
+            # Budget for host cofactored rechecks of strict rejects: each
+            # costs ~ms of pure-Python point math, so an attacker flooding
+            # well-formed invalid signatures must not pin the verify
+            # thread (a reject past the budget stands as strict — the
+            # divergence window exists only under active flooding, which
+            # is itself evidence of a misbehaving committee peer).
+            recheck_budget = 64
+
+            def settle(verdicts, lo):
+                nonlocal recheck_budget
+                if self.mode != "msm":
+                    return verdicts
+                for t in np.flatnonzero(~verdicts):
+                    if recheck_budget <= 0:
+                        break
+                    recheck_budget -= 1
+                    pk, msg, sig = items[int(idx[lo + int(t)])]
+                    verdicts[int(t)] = _cofactored_verify(
+                        self.kernel, pk, msg, sig
+                    )
+                return verdicts
+
             for kind, lo, hi, pad, out in outs:
                 if kind == "item":
-                    results[lo:hi] = np.asarray(out)[: hi - lo]
+                    # Same cofactored semantics for small buckets: in msm
+                    # mode the accept set must not depend on flush size.
+                    results[lo:hi] = settle(
+                        np.asarray(out)[: hi - lo].copy(), lo
+                    )
                     continue
                 (v_dev, valid_dev), sum_s = out
                 valid = np.asarray(valid_dev)
@@ -295,12 +321,7 @@ class TpuVerifier:
                     fallback = np.asarray(
                         self._dispatch_items(packed, lo, hi, pad)
                     )[: hi - lo].copy()
-                    for t in np.flatnonzero(~fallback):
-                        pk, msg, sig = items[int(idx[lo + int(t)])]
-                        fallback[int(t)] = _cofactored_verify(
-                            self.kernel, pk, msg, sig
-                        )
-                    results[lo:hi] = fallback
+                    results[lo:hi] = settle(fallback, lo)
             ok[idx] = results
         return ok.tolist()
 
