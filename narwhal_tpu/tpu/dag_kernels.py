@@ -288,7 +288,7 @@ class TpuBullshark:
         def compile_ahead():
             try:
                 N = self.win.N
-                for kpad in (1, 2):  # steady state + first catch-up bucket
+                for kpad in (1, 2, 4):  # steady state + catch-up chain buckets
                     self._chain_commit.lower(
                         np.zeros((W, N, N), np.uint8),
                         np.zeros((W, N), np.uint8),
@@ -301,8 +301,11 @@ class TpuBullshark:
             except Exception:  # pragma: no cover - warmup is best-effort
                 import logging
 
-                logging.getLogger("narwhal.tpu").debug(
-                    "window prewarm failed", exc_info=True
+                # Transient failures (tunnel hiccups) must not permanently
+                # disable prewarming this shape for the process.
+                _PREWARMED_SHAPES.discard(key)
+                logging.getLogger("narwhal.tpu").warning(
+                    "window prewarm failed for %s", key, exc_info=True
                 )
 
         t = threading.Thread(target=compile_ahead, daemon=True)

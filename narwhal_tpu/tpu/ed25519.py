@@ -355,13 +355,22 @@ def _select_const(table, digit):
 
 @jax.jit
 def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
-    """Cofactorless check per lane: encode([S]B + [k](-A)) == (r_y, r_sign).
+    """Per-lane check of [S]B + [k](−A) against R, under BOTH rules:
+
+    strict (cofactorless, the host library's): encode(Rcheck) == (r_y,
+    r_sign); cofactored (RFC 8032 / dalek batch): [8](Rcheck − R) ==
+    identity. Computing both in one pass costs one R decompression + four
+    point ops (~10%) and lets the msm fallback use a DETERMINISTIC
+    device-side cofactored verdict — no per-item host bigint recheck an
+    attacker could amplify, no budget that would make verdicts depend on
+    flush composition.
 
     Host-facing shapes (batch-leading): a_y/r_y int[B, NLIMB] canonical y
     limbs; a_sign/r_sign int[B]; k_digits/s_digits int[B, 64] 4-bit digits
     MSB-first. Narrow dtypes welcome — limbs fit int16 and digits int8, so
     the host sends ~3x fewer bytes over the device link; everything is
-    widened to int32 lanes here. Returns bool[B].
+    widened to int32 lanes here. Returns (strict bool[B], cofactored
+    bool[B]).
     """
     a_y = a_y.T.astype(jnp.int32)  # -> limb-major [NLIMB, B]
     r_y = r_y.T.astype(jnp.int32)
@@ -409,8 +418,15 @@ def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
     x = fe_mul(acc[0], zinv)
     y = fe_mul(acc[1], zinv)
     x_can = fe_canonical(x)
-    ok = fe_eq(y, r_y) & ((x_can[0] & 1) == r_sign)
-    return ok & valid
+    ok_strict = fe_eq(y, r_y) & ((x_can[0] & 1) == r_sign) & valid
+
+    # Cofactored verdict: [8](Rcheck + (−R)) == identity.
+    r_point, r_valid = decompress(r_y, r_sign)
+    diff = pt_add(acc, pt_neg(r_point))
+    for _ in range(3):
+        diff = pt_double(diff)
+    ok_cof = fe_eq(diff[0], jnp.zeros_like(diff[0])) & fe_eq(diff[1], diff[2])
+    return ok_strict, ok_cof & valid & r_valid
 
 
 # ---------------------------------------------------------------------------
@@ -549,13 +565,24 @@ def msm_accumulate_kernel(a_y, a_sign, r_y, r_sign, ak_digits, z_digits, chunk=1
 
 
 def bytes_to_limbs(raw: np.ndarray) -> np.ndarray:
-    """[B, 32] uint8 little-endian -> [B, NLIMB] int32 (sign bit cleared)."""
-    raw = raw.copy()
-    raw[:, 31] &= 0x7F
-    bits = np.unpackbits(raw, axis=1, bitorder="little")  # [B, 256]
-    bits = np.pad(bits, ((0, 0), (0, NLIMB * RADIX - 256)))
-    weights = (1 << np.arange(RADIX, dtype=np.int32))
-    return (bits.reshape(-1, NLIMB, RADIX) * weights).sum(axis=2).astype(np.int32)
+    """[B, 32] uint8 little-endian -> [B, NLIMB] int32 (sign bit cleared).
+
+    Direct 3-byte gathers per limb (limb i = bits [13i, 13i+13), which span
+    at most 3 bytes): ~60 vectorized ops total, ~10x faster than the
+    unpackbits route — this runs in the host packing loop that bounds the
+    pipelined verify rate."""
+    raw32 = np.zeros((raw.shape[0], 33), np.int32)  # +1 zero column for i=19
+    raw32[:, :32] = raw
+    raw32[:, 31] &= 0x7F
+    out = np.empty((raw.shape[0], NLIMB), np.int32)
+    for i in range(NLIMB):
+        bit = RADIX * i
+        b, shift = bit >> 3, bit & 7
+        val = raw32[:, b] | (raw32[:, b + 1] << 8)
+        if shift + RADIX > 16:
+            val |= raw32[:, b + 2] << 16
+        out[:, i] = (val >> shift) & MASK
+    return out
 
 
 def bytes_to_digits(raw: np.ndarray) -> np.ndarray:

@@ -51,9 +51,10 @@ def msm_epilogue_check(v_limbs: np.ndarray, sum_s: int, kernel) -> bool:
     probability 1/8 over z, making two honest verifiers of the SAME bytes
     disagree at random (a consensus-splitting vector). This matches
     ed25519-dalek's batch_verify semantics (RFC 8032 cofactored); the
-    strict per-item rule differs on such crafted inputs, so the msm
-    fallback re-checks strict rejects against the cofactored rule
-    (_cofactored_verify) to keep the whole tpu backend deterministic.
+    strict per-item rule differs on such crafted inputs, so in msm mode
+    every per-item verdict (small buckets, fallback) also uses the
+    kernel's device-computed cofactored output, keeping the whole tpu
+    backend deterministic.
     Committees must not mix cofactored (tpu) and cofactorless (cpu host
     library) backends if adversarially-crafted torsion keys are a concern.
     """
@@ -74,31 +75,6 @@ def msm_epilogue_check(v_limbs: np.ndarray, sum_s: int, kernel) -> bool:
     return acc[0] % ref.P == 0 and (acc[1] - acc[2]) % ref.P == 0
 
 
-def _cofactored_verify(kernel, pk: bytes, msg: bytes, sig: bytes) -> bool:
-    """Host cofactored single verification (RFC 8032 style):
-    [8]([S]B − [k]A − R) == identity. Used only on the rare msm-fallback
-    path for items the strict per-item kernel rejected, so the tpu
-    backend's accept set is deterministically the cofactored one."""
-    ref = kernel.ref
-    a = ref.decompress(pk)
-    r = ref.decompress(sig[:32])
-    if a is None or r is None:
-        return False
-    s_int = int.from_bytes(sig[32:], "little")
-    if s_int >= ref.L:
-        return False
-    k = ref.sha512_mod_l(sig[:32], pk, msg)
-    acc = ref.point_add(
-        ref.point_mul(s_int, ref.G),
-        ref.point_add(
-            ref.point_mul(k, ref.point_neg(a)), ref.point_neg(r)
-        ),
-    )
-    for _ in range(3):
-        acc = ref.point_double(acc)
-    return acc[0] % ref.P == 0 and (acc[1] - acc[2]) % ref.P == 0
-
-
 class TpuVerifier:
     """Synchronous batch verifier backed by the JAX kernels.
 
@@ -108,7 +84,10 @@ class TpuVerifier:
     per-item kernel's throughput). A failed bucket (any bad or malformed
     signature) falls back to the per-item kernel to locate offenders, so
     adversarial input degrades one bucket to ~old cost, never correctness.
-    mode="item": always the per-item Straus kernel.
+    All msm-mode verdicts — the batch check, small buckets and the
+    per-item fallback — use the device-computed COFACTORED rule, so the
+    accept set is deterministic and independent of flush composition.
+    mode="item": always the per-item Straus kernel, strict verdict.
     """
 
     def __init__(
@@ -147,31 +126,36 @@ class TpuVerifier:
         if n == 0:
             return (np.zeros(0, bool), np.zeros(0, np.int64), [], None, items)
         ok = np.zeros(n, bool)
-        a_raw = np.zeros((n, 32), np.uint8)
-        r_raw = np.zeros((n, 32), np.uint8)
-        s_raw = np.zeros((n, 32), np.uint8)
-        k_raw = np.zeros((n, 32), np.uint8)
+        # Hot packing loop: list-append + one join per column — per-row
+        # numpy assignments cost ~3x more Python overhead per item, and at
+        # 100k+ items/s this loop IS the pipelined path's ceiling.
+        a_list: list[bytes] = []
+        r_list: list[bytes] = []
+        s_list: list[bytes] = []
+        k_list: list[bytes] = []
         k_ints = [0] * n
         s_ints = [0] * n
         precheck = np.zeros(n, bool)
+        L = self.kernel.ref.L
+        P_MASKED = self.kernel.ref.P
+        sha512 = hashlib.sha512
+        top_mask = (1 << 255) - 1
         for i, (pk, msg, sig) in enumerate(items):
             if len(pk) != 32 or len(sig) != 64:
                 continue
             rs, sb = sig[:32], sig[32:]
             s_int = int.from_bytes(sb, "little")
-            if s_int >= self.kernel.ref.L:
+            if s_int >= L:
                 continue
-            if (int.from_bytes(pk, "little") & ((1 << 255) - 1)) >= self.kernel.ref.P:
+            if (int.from_bytes(pk, "little") & top_mask) >= P_MASKED:
                 continue
-            if (int.from_bytes(rs, "little") & ((1 << 255) - 1)) >= self.kernel.ref.P:
+            if (int.from_bytes(rs, "little") & top_mask) >= P_MASKED:
                 continue
-            k_int = int.from_bytes(
-                hashlib.sha512(rs + pk + msg).digest(), "little"
-            ) % self.kernel.ref.L
-            a_raw[i] = np.frombuffer(pk, np.uint8)
-            r_raw[i] = np.frombuffer(rs, np.uint8)
-            s_raw[i] = np.frombuffer(sb, np.uint8)
-            k_raw[i] = np.frombuffer(k_int.to_bytes(32, "little"), np.uint8)
+            k_int = int.from_bytes(sha512(rs + pk + msg).digest(), "little") % L
+            a_list.append(pk)
+            r_list.append(rs)
+            s_list.append(sb)
+            k_list.append(k_int.to_bytes(32, "little"))
             k_ints[i] = k_int
             s_ints[i] = s_int
             precheck[i] = True
@@ -180,14 +164,18 @@ class TpuVerifier:
         if idx.size == 0:
             return (ok, idx, [], None, items)
 
+        def rows(chunks: list[bytes]) -> np.ndarray:
+            return np.frombuffer(b"".join(chunks), np.uint8).reshape(-1, 32)
+
+        a_raw, r_raw = rows(a_list), rows(r_list)
         # Narrow upload dtypes (limbs < 2^13, digits < 16): ~3x fewer bytes
         # over the device link; the kernel widens to int32 lanes on device.
-        a_y = self.kernel.bytes_to_limbs(a_raw[idx]).astype(np.int16)
-        r_y = self.kernel.bytes_to_limbs(r_raw[idx]).astype(np.int16)
-        a_sign = (a_raw[idx, 31] >> 7).astype(np.int8)
-        r_sign = (r_raw[idx, 31] >> 7).astype(np.int8)
-        k_digits = self.kernel.bytes_to_digits(k_raw[idx]).astype(np.int8)
-        s_digits = self.kernel.bytes_to_digits(s_raw[idx]).astype(np.int8)
+        a_y = self.kernel.bytes_to_limbs(a_raw).astype(np.int16)
+        r_y = self.kernel.bytes_to_limbs(r_raw).astype(np.int16)
+        a_sign = (a_raw[:, 31] >> 7).astype(np.int8)
+        r_sign = (r_raw[:, 31] >> 7).astype(np.int8)
+        k_digits = self.kernel.bytes_to_digits(rows(k_list)).astype(np.int8)
+        s_digits = self.kernel.bytes_to_digits(rows(s_list)).astype(np.int8)
         packed = (a_y, a_sign, r_y, r_sign, k_digits, s_digits)
 
         outs = []  # (kind, lo, hi, device out)
@@ -207,7 +195,7 @@ class TpuVerifier:
             else:
                 out = self._dispatch_items(packed, lo, hi, pad)
                 kind = "item"
-                arrays = (out,)
+                arrays = out  # (strict, cofactored) device arrays
             # Kick off the device->host copy as soon as the kernel finishes
             # so collect() finds the bytes already local instead of paying
             # the transfer round trip synchronously.
@@ -242,17 +230,18 @@ class TpuVerifier:
         L = self.kernel.ref.L
         m = hi - lo
         rnd = _os.urandom(16 * m)
-        zs = [int.from_bytes(rnd[16 * t : 16 * (t + 1)], "little") for t in range(m)]
-        ak_raw = np.zeros((m + pad, 32), np.uint8)
-        z_raw = np.zeros((m + pad, 32), np.uint8)
+        from_bytes = int.from_bytes
+        ak_parts: list[bytes] = []
         sum_s = 0
-        for t in range(m):
-            j = int(idx[lo + t])
-            ak_raw[t] = np.frombuffer(
-                ((zs[t] * k_ints[j]) % L).to_bytes(32, "little"), np.uint8
-            )
-            z_raw[t, :16] = np.frombuffer(zs[t].to_bytes(16, "little"), np.uint8)
-            sum_s += zs[t] * s_ints[j]
+        for t, j in enumerate(idx[lo:hi].tolist()):
+            z = from_bytes(rnd[16 * t : 16 * (t + 1)], "little")
+            ak_parts.append(((z * k_ints[j]) % L).to_bytes(32, "little"))
+            sum_s += z * s_ints[j]
+        if pad:
+            ak_parts.append(b"\0" * (32 * pad))
+        ak_raw = np.frombuffer(b"".join(ak_parts), np.uint8).reshape(-1, 32)
+        z_raw = np.zeros((m + pad, 32), np.uint8)
+        z_raw[:m, :16] = np.frombuffer(rnd, np.uint8).reshape(m, 16)
 
         ak_digits = self.kernel.bytes_to_digits(ak_raw).astype(np.int8)
         # z < 2^128: the MSB-first digit vector's low half carries it.
@@ -281,35 +270,17 @@ class TpuVerifier:
         ok, idx, outs, packed, items = handle
         if idx.size:
             results = np.zeros(idx.size, bool)
-            # Budget for host cofactored rechecks of strict rejects: each
-            # costs ~ms of pure-Python point math, so an attacker flooding
-            # well-formed invalid signatures must not pin the verify
-            # thread (a reject past the budget stands as strict — the
-            # divergence window exists only under active flooding, which
-            # is itself evidence of a misbehaving committee peer).
-            recheck_budget = 64
-
-            def settle(verdicts, lo):
-                nonlocal recheck_budget
-                if self.mode != "msm":
-                    return verdicts
-                for t in np.flatnonzero(~verdicts):
-                    if recheck_budget <= 0:
-                        break
-                    recheck_budget -= 1
-                    pk, msg, sig = items[int(idx[lo + int(t)])]
-                    verdicts[int(t)] = _cofactored_verify(
-                        self.kernel, pk, msg, sig
-                    )
-                return verdicts
+            # In msm mode EVERY verdict is the device-computed cofactored
+            # one — small buckets, fallback buckets and the batch check all
+            # share one accept set, so no signature's fate can depend on
+            # flush size or bucket composition (consensus-split safety),
+            # and there is no per-item host recheck an attacker could
+            # amplify. mode="item" keeps the strict (host-library) rule.
+            pick = 1 if self.mode == "msm" else 0
 
             for kind, lo, hi, pad, out in outs:
                 if kind == "item":
-                    # Same cofactored semantics for small buckets: in msm
-                    # mode the accept set must not depend on flush size.
-                    results[lo:hi] = settle(
-                        np.asarray(out)[: hi - lo].copy(), lo
-                    )
+                    results[lo:hi] = np.asarray(out[pick])[: hi - lo]
                     continue
                 (v_dev, valid_dev), sum_s = out
                 valid = np.asarray(valid_dev)
@@ -318,10 +289,8 @@ class TpuVerifier:
                 ):
                     results[lo:hi] = True
                 else:
-                    fallback = np.asarray(
-                        self._dispatch_items(packed, lo, hi, pad)
-                    )[: hi - lo].copy()
-                    results[lo:hi] = settle(fallback, lo)
+                    fallback = self._dispatch_items(packed, lo, hi, pad)
+                    results[lo:hi] = np.asarray(fallback[1])[: hi - lo]
             ok[idx] = results
         return ok.tolist()
 

@@ -204,7 +204,7 @@ def test_leader_support_kernel():
     assert got == 16  # 5 + 11
 
 
-def test_window_growth_is_precompiled(run=None):
+def test_window_growth_is_precompiled():
     """_grow() doubles W mid-stream exactly when the node is behind; the
     engine must keep the doubled shape compiled AHEAD of need (VERDICT r2
     weak #7). We assert the prewarm covers the next size before growth and

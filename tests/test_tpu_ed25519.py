@@ -250,3 +250,13 @@ def test_msm_torsion_defect_is_deterministic(msm_verifier):
     # the torsion-defect signature is uniformly ACCEPTED.
     assert all(r == results[0] for r in results)
     assert results[0] == [True] * 16
+
+    # Same torsion signature in a FAILING bucket (a corrupted co-passenger
+    # forces the per-item fallback): the verdict must not change — the
+    # fallback also answers with the device's cofactored rule.
+    items2 = _items(14, tag=10) + [(pk_t, msg, sig)]
+    pk0, msg0, sig0 = items2[0]
+    items2[0] = (pk0, msg0, sig0[:8] + bytes([sig0[8] ^ 1]) + sig0[9:])
+    results2 = [msm_verifier(items2) for _ in range(3)]
+    assert all(r == results2[0] for r in results2)
+    assert results2[0] == [False] + [True] * 14
