@@ -54,10 +54,10 @@ def run(size: int, rounds: int, gc: int) -> None:
         return time.perf_counter() - t0, seq
 
     host = Bullshark(f.committee, NodeStorage(None).consensus_store, gc)
-    dev = TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc)
+    dev = TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc, prewarm=False)
 
     # Warmup compiles the device kernels for this (W, N) shape.
-    warm = TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc)
+    warm = TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc, prewarm=False)
     stream(warm)
 
     host_dt, host_seq = stream(host)
@@ -89,7 +89,7 @@ def run(size: int, rounds: int, gc: int) -> None:
 
     dk.chain_commit = timed
     try:
-        stream(TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc))
+        stream(TpuBullshark(f.committee, NodeStorage(None).consensus_store, gc, prewarm=False))
     finally:
         dk.chain_commit = orig
 

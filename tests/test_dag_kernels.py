@@ -241,5 +241,7 @@ def test_window_growth_is_precompiled():
     dev.win.insert(lead, 0)
     t0 = time.monotonic()
     dev.process_certificate(state, 0, sup)
-    assert time.monotonic() - t0 < 10.0, "post-growth dispatch stalled"
+    # Generous bound: proves "no cold multi-minute compile", robust to
+    # parallel load on a 1-core CI host.
+    assert time.monotonic() - t0 < 30.0, "post-growth dispatch stalled"
 
