@@ -131,3 +131,92 @@ def test_configuration_api(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=90.0)
+
+
+def test_validator_api_error_paths(run):
+    """Unknown digests, unknown validators and malformed ids must come back
+    as errors/empty results — never hangs or crashes (the reference's
+    validator-API integration suite exercises exactly these,
+    integration_tests_validator_api.rs)."""
+
+    async def scenario():
+        cluster, client = await _api_cluster()
+        try:
+            node = cluster.authorities[0]
+            api = node.primary.api_address
+            pk = node.name
+            await _wait_rounds(client, api, pk, 2)
+
+            # GetCollections of a digest that exists nowhere: per-collection
+            # error, same-length results, service stays up.
+            ghost = bytes([0xEE]) * 32
+            got = await client.request(api, GetCollectionsRequest((ghost,)))
+            assert len(got.results) == 1
+            assert got.results[0][2] != ""  # explicit error string
+
+            # ReadCausal from an unknown start: an error reply, not a hang.
+            try:
+                rc = await asyncio.wait_for(
+                    client.request(api, ReadCausalRequest(ghost)), 10.0
+                )
+                assert rc.digests == ()
+            except RpcError:
+                pass  # an explicit error is equally acceptable
+
+            # Rounds for a key outside the committee: error, not a crash.
+            try:
+                resp = await client.request(api, RoundsRequest(bytes(32)))
+                raise AssertionError(f"unknown validator answered: {resp}")
+            except RpcError:
+                pass
+
+            # NodeReadCausal beyond any produced round: error/empty.
+            try:
+                nrc = await client.request(api, NodeReadCausalRequest(pk, 1 << 40))
+                assert nrc.digests == ()
+            except RpcError:
+                pass
+
+            # The service still works after all the garbage.
+            rounds = await client.request(api, RoundsRequest(pk))
+            assert rounds.newest_round >= 2
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
+
+
+def test_cross_node_collection_fetch(run):
+    """Collections authored by node B are retrievable through node A's
+    Validator API (the reference's headline integration case: fetching
+    collections that live on a peer — BlockWaiter + BlockSynchronizer)."""
+
+    async def scenario():
+        cluster, client = await _api_cluster()
+        try:
+            a, b = cluster.authorities[0], cluster.authorities[1]
+            # B's newest causal collections...
+            rounds_b = await _wait_rounds(client, b.primary.api_address, b.name, 2)
+            nrc = await client.request(
+                b.primary.api_address,
+                NodeReadCausalRequest(b.name, rounds_b.newest_round),
+            )
+            assert nrc.digests
+            # ...fetched through A's API.
+            got = await client.request(
+                a.primary.api_address, GetCollectionsRequest(nrc.digests),
+                timeout=30.0,  # covers the server-side peer-sync window
+            )
+            assert len(got.results) == len(nrc.digests)
+            resolved = [r for r in got.results if r[2] == ""]
+            assert resolved, f"nothing resolved cross-node: {[r[2] for r in got.results]}"
+            # At least one resolved collection must carry real batches, so
+            # the fetch genuinely exercised payload retrieval rather than
+            # only empty timer-driven headers.
+            assert any(batches for _, batches, _ in resolved)
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=120.0)

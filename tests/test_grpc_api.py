@@ -140,3 +140,69 @@ def test_grpc_end_to_end(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=90.0)
+
+
+def test_grpc_error_paths(run):
+    """Malformed and unknown inputs over the public gRPC plane: proper
+    status codes / per-item errors, never a crash; NewEpoch is
+    UNIMPLEMENTED (exact reference parity, configuration.rs:78-81)."""
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1, internal_consensus=False)
+        await cluster.start()
+        channel = None
+        try:
+            node = cluster.authorities[0]
+            addr = node.primary.grpc_api_address
+            channel = grpc.aio.insecure_channel(addr)
+            rounds = _unary(channel, "Proposer", "Rounds", pb.RoundsResponse)
+            get = _unary(
+                channel, "Validator", "GetCollections", pb.GetCollectionsResponse
+            )
+            new_epoch = _unary(channel, "Configuration", "NewEpoch", pb.Empty)
+            await _wait_rounds(rounds, node.name, 2)
+
+            # Unknown digest: per-collection error in the response.
+            resp = await get(pb.CollectionRequest(collection_ids=[b"\xee" * 32]))
+            assert len(resp.results) == 1
+            assert resp.results[0].error != ""  # explicit per-item error
+
+            # Malformed (short) digest: clean error, service stays up.
+            try:
+                resp_short = await get(
+                    pb.CollectionRequest(collection_ids=[b"short"])
+                )
+                # Non-aborting servers must still flag the item as an error.
+                assert resp_short.results[0].error != ""
+            except grpc.aio.AioRpcError as e:
+                assert e.code() in (
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    grpc.StatusCode.INTERNAL,
+                )
+            # Unknown validator key.
+            try:
+                await rounds(pb.RoundsRequest(public_key=b"\x00" * 32))
+                raise AssertionError("unknown validator must error")
+            except grpc.aio.AioRpcError as e:
+                assert e.code() in (
+                    grpc.StatusCode.NOT_FOUND,
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    grpc.StatusCode.INTERNAL,
+                )
+
+            # NewEpoch: reference parity — UNIMPLEMENTED.
+            try:
+                await new_epoch(pb.NewEpochRequest(epoch_number=1))
+                raise AssertionError("NewEpoch must be unimplemented")
+            except grpc.aio.AioRpcError as e:
+                assert e.code() == grpc.StatusCode.UNIMPLEMENTED
+
+            # Still alive.
+            resp = await rounds(pb.RoundsRequest(public_key=node.name))
+            assert resp.newest_round >= 2
+        finally:
+            if channel is not None:
+                await channel.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
