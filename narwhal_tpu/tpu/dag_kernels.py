@@ -125,6 +125,18 @@ def chain_commit(parent, present, gc_depth, lc_rel, lcr_rel, offs, onehots):
 # (W, N, auth-shards) chain_commit shapes already queued for background
 # compilation in this process (prewarm dedupe across engine instances).
 _PREWARMED_SHAPES: set[tuple[int, int, int]] = set()
+# Live prewarm threads, joined at interpreter exit: a daemon thread frozen
+# inside XLA C++ during Python finalization aborts the whole process
+# ("FATAL: exception not rethrown"), so exit must wait for in-flight
+# compiles. Long-lived nodes finish them long before shutdown; one-shot
+# tools pass prewarm=False and never start them.
+_PREWARM_THREADS: list = []
+_PREWARM_ATEXIT = False
+
+
+def _join_prewarm_threads() -> None:
+    for t in list(_PREWARM_THREADS):
+        t.join()
 
 
 class DagWindow:
@@ -308,9 +320,16 @@ class TpuBullshark:
                     "window prewarm failed for %s", key, exc_info=True
                 )
 
+        global _PREWARM_ATEXIT
+        if not _PREWARM_ATEXIT:
+            import atexit
+
+            atexit.register(_join_prewarm_threads)
+            _PREWARM_ATEXIT = True
         t = threading.Thread(target=compile_ahead, daemon=True)
         t.start()
         self._prewarm_threads.append(t)
+        _PREWARM_THREADS.append(t)
 
     def _pad_for(self, committee: Committee) -> int | None:
         """Committee-axis width the mesh requires: the next multiple of the
