@@ -36,9 +36,15 @@ async def run_bench(args) -> dict:
     from narwhal_tpu.messages import SubmitTransactionStreamMsg
     from narwhal_tpu.network import NetworkClient
 
+    from narwhal_tpu.config import Parameters
+
     cluster = Cluster(
         size=args.nodes,
         workers=args.workers,
+        parameters=Parameters(
+            max_header_delay=args.max_header_delay,
+            max_batch_delay=args.max_batch_delay,
+        ),
         crypto_backend=args.crypto_backend,
         dag_backend=args.dag_backend,
         dag_shards=args.dag_shards,
@@ -152,6 +158,8 @@ def main() -> None:
     ap.add_argument("--tx-size", type=int, default=512)
     ap.add_argument("--duration", type=int, default=30)
     ap.add_argument("--drain-tail", type=float, default=5.0)
+    ap.add_argument("--max-header-delay", type=float, default=0.05)
+    ap.add_argument("--max-batch-delay", type=float, default=0.05)
     ap.add_argument("--warmup-timeout", type=float, default=120.0,
                     help="boot-to-first-commits window (TPU backends pay a\n"
                     "first-compile + tunnel-RTT warmup)")

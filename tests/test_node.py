@@ -312,3 +312,29 @@ def test_twenty_node_committee_with_faults(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=150.0)
+
+
+def test_fifty_node_committee_liveness(run):
+    """The north-star committee size: a 50-node in-process committee over
+    the authenticated mesh reaches lockstep commits (each round is ~7.5k
+    signed+sealed control messages on this host's single core, so the
+    assertion is liveness, not throughput — see
+    benchmark/results/n50_liveness.json)."""
+    from narwhal_tpu.config import Parameters
+
+    async def scenario():
+        cluster = Cluster(
+            size=50, workers=1,
+            parameters=Parameters(max_header_delay=1.0, max_batch_delay=0.5),
+        )
+        await cluster.start()
+        try:
+            rounds = await cluster.assert_progress(
+                commit_threshold=2, timeout=240.0
+            )
+            assert len(rounds) == 50
+            assert min(rounds.values()) >= 2
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=300.0)
