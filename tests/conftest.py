@@ -17,6 +17,9 @@ import pytest
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration test"
+    )
     import jax
 
     if jax.default_backend() != "cpu":

@@ -53,6 +53,11 @@ def test_remote_bench_flow_on_local_connections(tmp_path):
         parser = bench.run(rate=800, tx_size=128, duration=20)
         if parser.consensus_throughput()[0] <= 0:
             parser = bench.run(rate=800, tx_size=128, duration=35)
+        if parser.consensus_throughput()[0] <= 0:
+            # Full-suite runs on this 1-core host can contend hard enough
+            # that two windows both miss; the final escalation is sized so
+            # a genuine orchestration failure still fails the test.
+            parser = bench.run(rate=800, tx_size=128, duration=60)
         result = parser.result()
         assert "Consensus TPS" in result
         assert parser.to_dict()["consensus_tps"] > 0, result
