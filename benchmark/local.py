@@ -56,6 +56,17 @@ class LocalBench:
         self.node_parameters = node_parameters or Parameters(
             max_header_delay=0.1, max_batch_delay=0.1
         )
+        if bench.crypto_backend == "tpu" and node_parameters is None:
+            # Default only: the whole fleet runs the tpu backend, so the
+            # committee can uniformly opt into the cofactored accept set —
+            # unlocking the msm batch kernel. An explicitly passed
+            # Parameters keeps its verify_rule (e.g. to benchmark the
+            # strict per-item kernel).
+            from dataclasses import replace
+
+            self.node_parameters = replace(
+                self.node_parameters, verify_rule="cofactored"
+            )
         self.base = os.path.abspath(".bench")
         self.procs: list[subprocess.Popen] = []
 
@@ -107,6 +118,15 @@ class LocalBench:
     def _spawn(self, argv: list[str], log_path: str) -> None:
         log = open(log_path, "w")
         env = dict(os.environ, PYTHONPATH=os.path.dirname(self.base) or ".")
+        # This parent assigned every node's ports and holds SO_REUSEPORT
+        # placeholders for them until the fleet is up; the children must
+        # co-bind through those placeholders (RpcServer only sets
+        # reuse_port for ports it can prove are placeheld). Advertise the
+        # EXACT list — a blanket "all" would reinstate silent co-binding
+        # for genuinely duplicate servers.
+        from narwhal_tpu.config import placeheld_ports
+
+        env["NARWHAL_PLACEHELD_PORTS"] = ",".join(map(str, placeheld_ports()))
         if env.get("JAX_PLATFORMS") == "cpu":
             # The axon TPU plugin self-registers via sitecustomize whenever
             # PALLAS_AXON_POOL_IPS is set and wins over JAX_PLATFORMS; a

@@ -41,6 +41,8 @@ REMOTE_DIR = "~/narwhal-tpu"
 class Connection:
     """Thin ssh/scp wrapper: run a command, push a file, pull a file."""
 
+    node_env = ""  # extra VAR=val prefix for launched node commands
+
     def __init__(self, host: str, ssh_opts: tuple[str, ...] = ("-o", "BatchMode=yes")):
         self.host = host
         self.ssh_opts = list(ssh_opts)
@@ -79,6 +81,22 @@ class LocalConnection(Connection):
         super().__init__(host)
         self.root = root
         os.makedirs(root, exist_ok=True)
+
+    @property
+    def node_env(self) -> str:
+        # The simulated hosts share this machine with the orchestrating
+        # parent, which may hold SO_REUSEPORT placeholders on assigned
+        # ports (e.g. the test's base_port); node children must co-bind
+        # through them (RpcServer only sets reuse_port for ports proven
+        # placeheld). Advertise the exact live list — never "all", which
+        # would let genuinely duplicate servers co-bind silently. Real ssh
+        # hosts keep the empty default: no placeholder exists there.
+        from narwhal_tpu.config import placeheld_ports
+
+        ports = placeheld_ports()
+        if not ports:
+            return ""
+        return "NARWHAL_PLACEHELD_PORTS=" + ",".join(map(str, ports))
 
     def _localize(self, text: str) -> str:
         return text.replace("~", self.root)
@@ -207,9 +225,10 @@ class RemoteBench:
         return {"committee": committee, "workers": workers}
 
     # -- 3/4. start / stop -------------------------------------------------
-    def _node_cmd(self, role: str, log: str, extra: str = "") -> str:
+    def _node_cmd(self, role: str, log: str, extra: str = "", env: str = "") -> str:
+        prefix = f"{env} " if env else ""
         return (
-            f"cd {REMOTE_DIR} && nohup python3 -m narwhal_tpu -v run "
+            f"cd {REMOTE_DIR} && {prefix}nohup python3 -m narwhal_tpu -v run "
             f"--keys configs/key.json --committee configs/committee.json "
             f"--workers configs/workers.json --parameters configs/parameters.json "
             f"--store db {role} {extra} < /dev/null > {log}.log 2>&1 &"
@@ -218,10 +237,15 @@ class RemoteBench:
     def start(self, faults: int = 0) -> None:
         alive = self.conns[: len(self.conns) - faults]
         for conn in alive:
-            conn.run(self._node_cmd("primary", "primary"), capture=False)
+            conn.run(
+                self._node_cmd("primary", "primary", env=conn.node_env),
+                capture=False,
+            )
             for w in range(self.workers):
                 conn.run(
-                    self._node_cmd("worker", f"worker-{w}", f"--id {w}"),
+                    self._node_cmd(
+                        "worker", f"worker-{w}", f"--id {w}", env=conn.node_env
+                    ),
                     capture=False,
                 )
 

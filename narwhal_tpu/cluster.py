@@ -80,6 +80,13 @@ class Cluster:
         self.parameters = parameters or replace(
             self.fixture.parameters, max_header_delay=0.05, max_batch_delay=0.05
         )
+        if crypto_backend == "tpu" and parameters is None:
+            # Default only: every node in this in-process cluster runs the
+            # tpu backend, so the committee can uniformly use the
+            # cofactored accept set (the msm batch kernel). An explicitly
+            # passed Parameters keeps its verify_rule — callers may want
+            # the strict per-item kernel on the tpu backend.
+            self.parameters = replace(self.parameters, verify_rule="cofactored")
         self.internal_consensus = internal_consensus
         self.benchmark = benchmark
         self.store_base = store_base

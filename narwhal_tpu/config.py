@@ -12,6 +12,8 @@ float seconds in memory, serialized as milliseconds in JSON.
 from __future__ import annotations
 
 import json
+import logging
+import os
 import socket
 from collections import deque
 from dataclasses import asdict, dataclass, field, replace
@@ -19,6 +21,8 @@ from typing import Mapping
 
 from .crypto import digest256
 from .types import Epoch, PublicKey, Round, WorkerId
+
+logger = logging.getLogger("narwhal.config")
 
 Stake = int
 
@@ -41,6 +45,17 @@ class Parameters:
     block_synchronizer_payload_retries: int = 5
     consensus_api_grpc_address: str = "127.0.0.1:0"
     prometheus_address: str = "127.0.0.1:0"
+    # Committee-wide ed25519 accept set — every node MUST use the same rule
+    # or adversarially crafted torsion-component signatures make honest
+    # nodes disagree (a consensus-split vector; see
+    # narwhal_tpu/tpu/verifier.py msm_epilogue_check):
+    #   strict     — the host library's cofactorless rule (ed25519-dalek
+    #                `verify` semantics); supported by every crypto backend.
+    #   cofactored — RFC 8032 batch rule (ed25519-dalek `batch_verify`
+    #                semantics); only the tpu backend implements it, and it
+    #                unlocks the msm batch kernel. Nodes on cpu/pool
+    #                backends refuse to start under this rule.
+    verify_rule: str = "strict"
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)
@@ -380,13 +395,49 @@ def get_available_port(host: str = "127.0.0.1") -> int:
         _HANDED_ORDER.append(port)
         _PLACEHOLDERS[port] = ph
         while len(_HANDED_ORDER) > _HANDED_WINDOW:
-            old = _HANDED_ORDER.popleft()
+            old = _HANDED_ORDER[0]
+            if old in _PLACEHOLDERS:
+                # Still placeheld: its server has not bound yet. Closing the
+                # placeholder here would re-open the exact collision it
+                # exists to prevent (an ephemeral connection or a fresh
+                # hand-out grabbing the port before the server binds), so
+                # keep it and let the window grow. Loud, because a window
+                # full of unbound ports usually means someone is leaking
+                # placeholders (forgot release_port/release_all_ports).
+                logger.warning(
+                    "port window (%d) full of still-placeheld ports; "
+                    "oldest=%d not evicted — check for placeholder leaks",
+                    _HANDED_WINDOW,
+                    old,
+                )
+                break
+            _HANDED_ORDER.popleft()
             _HANDED_OUT.discard(old)
-            stale = _PLACEHOLDERS.pop(old, None)
-            if stale is not None:
-                stale.close()
         return port
     raise OSError("no available port after 64 attempts")
+
+
+def placeheld_ports() -> list[int]:
+    """The ports this process currently reserves with live placeholders.
+    Harness parents advertise exactly this list (NARWHAL_PLACEHELD_PORTS)
+    to their node children, so the children co-bind only genuinely
+    placeheld ports and every other duplicate bind still fails fast."""
+    return sorted(_PLACEHOLDERS)
+
+
+def port_is_placeheld(port: int) -> bool:
+    """True when `port` is reserved by a live SO_REUSEPORT placeholder —
+    this process's (_PLACEHOLDERS) or a harness parent's, advertised via
+    NARWHAL_PLACEHELD_PORTS ("all", or a comma-separated port list). Servers
+    use this to decide whether co-binding with reuse_port is intended
+    (binding through a placeholder) or a misconfiguration that should fail
+    fast with EADDRINUSE (two servers on one address)."""
+    if port in _PLACEHOLDERS:
+        return True
+    env = os.environ.get("NARWHAL_PLACEHELD_PORTS", "")
+    if env == "all":
+        return True
+    return any(tok.strip() == str(port) for tok in env.split(",") if tok.strip())
 
 
 def release_port(port: int) -> None:

@@ -303,17 +303,28 @@ class RpcServer:
         self._handlers[msg_cls.TAG] = (handler, allow)
 
     async def start(self, host: str, port: int) -> int:
+        # reuse_port lets the bind coexist with the allocator's SO_REUSEPORT
+        # placeholder (config.get_available_port), which reserves
+        # pre-assigned ports against ephemeral collisions; the placeholder
+        # never listens, so all connections land here. But blanket
+        # reuse_port would also let two misconfigured servers (duplicate
+        # addresses in a committee file, the same node started twice)
+        # silently co-bind and nondeterministically split connections — so
+        # only co-bind ports that are actually known to be placeheld:
+        # either by this process's allocator, or by a harness parent that
+        # assigned our ports and advertises its placeholders via
+        # NARWHAL_PLACEHELD_PORTS ("all" or a comma-separated list). Any
+        # other duplicate fails fast with EADDRINUSE.
+        from ..config import port_is_placeheld
+
+        reuse = port != 0 and port_is_placeheld(port)
         # A pre-assigned port can transiently collide (TIME_WAIT, an
         # ephemeral outbound connection): retry briefly before giving up.
         for attempt in range(5):
             try:
-                # reuse_port lets the bind coexist with the allocator's
-                # SO_REUSEPORT placeholder (config.get_available_port), which
-                # reserves pre-assigned ports against ephemeral collisions;
-                # the placeholder never listens, so all connections land here.
                 self._server = await asyncio.start_server(
                     self._on_connection, host, port, limit=MAX_FRAME + 1024,
-                    reuse_port=(port != 0),
+                    reuse_port=reuse,
                 )
                 break
             except OSError:

@@ -106,11 +106,42 @@ class PrimaryNode:
         #   cpu  — inline host verification in the Core (reference behavior)
         #   pool — async coalescing stage over the host library
         #   tpu  — async coalescing stage over the TPU batch kernel
+        # The accept set is a COMMITTEE-WIDE parameter (Parameters.
+        # verify_rule), validated here at startup: the host library is
+        # cofactorless ("strict"), the TPU msm batch kernel is RFC-8032
+        # cofactored — a committee mixing the two can permanently disagree
+        # on adversarially crafted torsion signatures.
+        rule = getattr(parameters, "verify_rule", "strict")
+        if rule not in ("strict", "cofactored"):
+            raise ValueError(f"parameters.verify_rule must be strict|cofactored, got {rule!r}")
+        if rule == "cofactored" and crypto_backend != "tpu":
+            raise ValueError(
+                "parameters.verify_rule=cofactored: only the tpu crypto "
+                f"backend implements the cofactored accept set (got "
+                f"crypto_backend={crypto_backend!r}). Use --crypto-backend "
+                "tpu on every node, or set verify_rule=strict."
+            )
         crypto_pool = None
         if crypto_backend in ("pool", "tpu"):
             from .tpu.verifier import AsyncVerifierPool, make_batch_verifier
 
-            backend = make_batch_verifier() if crypto_backend == "tpu" else None
+            backend = None
+            if crypto_backend == "tpu":
+                if rule == "cofactored":
+                    logger.warning(
+                        "verify_rule=cofactored: EVERY node in this "
+                        "committee must run --crypto-backend tpu; a cpu/pool "
+                        "node (strict rule) in the same committee is a "
+                        "consensus-split hazard on crafted torsion signatures"
+                    )
+                # Under the cofactored rule the device path is mandatory:
+                # a construction-failure fallback to the host library
+                # would silently run the strict accept set for the node's
+                # whole lifetime.
+                backend = make_batch_verifier(
+                    mode="msm" if rule == "cofactored" else "item",
+                    require=rule == "cofactored",
+                )
             crypto_pool = AsyncVerifierPool(backend=backend)
         self.crypto_pool = crypto_pool
 

@@ -25,6 +25,8 @@ equivalence-tested against the host engine on random lossy DAGs
 from __future__ import annotations
 
 import functools
+import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -134,9 +136,29 @@ _PREWARM_THREADS: list = []
 _PREWARM_ATEXIT = False
 
 
+def _prune_prewarm_threads() -> None:
+    """Drop finished threads so a long-lived node doesn't accumulate one
+    Thread object per window doubling."""
+    _PREWARM_THREADS[:] = [t for t in _PREWARM_THREADS if t.is_alive()]
+
+
 def _join_prewarm_threads() -> None:
+    # Bounded join: waiting forever would make a hung tunneled device (stuck
+    # mid-compile in XLA C++) block process exit outright. 60 s is enough
+    # for any cache-served compile; a thread still alive after that is
+    # logged and abandoned — a daemon thread, so it cannot keep the
+    # interpreter alive, and the abort-on-finalization hazard the join
+    # exists to avoid is already vanishingly rare at that point.
+    deadline = time.monotonic() + 60.0
     for t in list(_PREWARM_THREADS):
-        t.join()
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            logging.getLogger("narwhal.tpu.dag").warning(
+                "prewarm compile thread %s did not finish within the exit "
+                "join window; abandoning it",
+                t.name,
+            )
+    _prune_prewarm_threads()
 
 
 class DagWindow:
@@ -326,6 +348,8 @@ class TpuBullshark:
 
             atexit.register(_join_prewarm_threads)
             _PREWARM_ATEXIT = True
+        _prune_prewarm_threads()
+        self._prewarm_threads = [t for t in self._prewarm_threads if t.is_alive()]
         t = threading.Thread(target=compile_ahead, daemon=True)
         t.start()
         self._prewarm_threads.append(t)

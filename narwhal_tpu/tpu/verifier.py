@@ -298,14 +298,33 @@ class TpuVerifier:
         return self.collect(self.submit(items))
 
 
-def make_batch_verifier(fallback_on_error: bool = True):
+def make_batch_verifier(
+    fallback_on_error: bool = True, mode: str | None = None, require: bool = False
+):
     """Build a crypto.BatchVerifier backed by the TPU kernel, falling back to
-    the host loop if the device path fails."""
+    the host loop if the device path fails.
+
+    `mode` pins the accept set ("item" = strict/cofactorless like the host
+    library, "msm" = cofactored batch rule); None defers to the
+    NARWHAL_TPU_VERIFY_MODE env default. Node startup always passes an
+    explicit mode derived from the committee-wide Parameters.verify_rule.
+
+    `require=True` raises instead of returning None when the device path
+    cannot be built: under a cofactored committee a silent host fallback
+    would permanently run the STRICT accept set — the consensus-split
+    hazard the startup validation exists to prevent — so the node must
+    refuse to start rather than limp along on the wrong rule."""
     from .. import crypto
 
     try:
-        verifier = TpuVerifier()
+        verifier = TpuVerifier(mode=mode)
     except Exception:  # jax/platform import failure
+        if require:
+            raise RuntimeError(
+                "TPU verifier unavailable but the committee's verify rule "
+                "requires it (host fallback implements a different accept "
+                "set); refusing to start"
+            )
         logger.exception("TPU verifier unavailable; using host verification")
         return None
 
@@ -315,7 +334,17 @@ def make_batch_verifier(fallback_on_error: bool = True):
         except Exception:
             if not fallback_on_error:
                 raise
-            logger.exception("TPU verify dispatch failed; host fallback")
+            # The host library is strict/cofactorless; under mode="msm"
+            # (cofactored committee) this error-path fallback is a
+            # different accept set — tolerable for a transient device
+            # hiccup, but say so loudly.
+            logger.exception(
+                "TPU verify dispatch failed; host fallback%s",
+                " (STRICT accept set, differs from the committee's"
+                " cofactored rule on crafted torsion signatures)"
+                if verifier.mode == "msm"
+                else "",
+            )
             return crypto._host_batch_verify(items)
 
     return backend

@@ -189,3 +189,45 @@ def test_large_frame(run):
         await server.stop()
 
     run(scenario())
+
+
+def test_duplicate_server_fails_fast_without_placeholder(run):
+    """Two RpcServers on the same explicit port must NOT silently co-bind
+    (reuse_port splitting connections nondeterministically): a port that no
+    allocator placeholder reserves is bound plainly, so the duplicate gets
+    EADDRINUSE (ADVICE r3). Ports actually placeheld by
+    config.get_available_port still co-bind through the placeholder."""
+    from narwhal_tpu.config import get_available_port, port_is_placeheld
+    from narwhal_tpu.network.rpc import RpcServer
+
+    async def scenario():
+        port = get_available_port()
+        assert port_is_placeheld(port)
+        a = RpcServer()
+        await a.start("127.0.0.1", port)  # binds through the placeholder
+        assert not port_is_placeheld(port)  # placeholder released on bind
+        b = RpcServer()
+        try:
+            with pytest.raises(OSError):
+                await b.start("127.0.0.1", port)
+        finally:
+            await a.stop()
+
+    run(scenario(), timeout=30.0)
+
+
+def test_placeheld_ports_env_enables_cobind(run, monkeypatch):
+    """A harness parent that assigned the ports advertises its placeholders
+    via NARWHAL_PLACEHELD_PORTS; children then co-bind with reuse_port."""
+    from narwhal_tpu.config import port_is_placeheld
+
+    monkeypatch.setenv("NARWHAL_PLACEHELD_PORTS", "all")
+    assert port_is_placeheld(12345)
+    monkeypatch.setenv("NARWHAL_PLACEHELD_PORTS", "7001, 7002")
+    assert port_is_placeheld(7002)
+    assert not port_is_placeheld(7003)
+
+    async def noop():
+        pass
+
+    run(noop(), timeout=5.0)

@@ -338,3 +338,37 @@ def test_fifty_node_committee_liveness(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=300.0)
+
+
+def test_verify_rule_validated_at_startup(tmp_path):
+    """parameters.verify_rule is a committee-wide accept-set contract: a
+    cpu/pool node (host library = strict/cofactorless rule) must refuse to
+    start under verify_rule=cofactored — mixing the two rules in one
+    committee is a consensus-split vector on crafted torsion signatures
+    (ADVICE r3; narwhal_tpu/tpu/verifier.py msm_epilogue_check)."""
+    from dataclasses import replace
+
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.node import NodeStorage, PrimaryNode
+
+    fx = CommitteeFixture(size=4)
+    auth = fx.authorities[0]
+    params = replace(fx.parameters, verify_rule="cofactored")
+    for backend in ("cpu", "pool"):
+        with pytest.raises(ValueError, match="cofactored"):
+            PrimaryNode(
+                auth.keypair,
+                fx.committee,
+                fx.worker_cache,
+                params,
+                NodeStorage(None),
+                crypto_backend=backend,
+            )
+    with pytest.raises(ValueError, match="verify_rule"):
+        PrimaryNode(
+            auth.keypair,
+            fx.committee,
+            fx.worker_cache,
+            replace(fx.parameters, verify_rule="bogus"),
+            NodeStorage(None),
+        )
