@@ -425,13 +425,36 @@ def placeheld_ports() -> list[int]:
     return sorted(_PLACEHOLDERS)
 
 
+# Ports with a live server bound by THIS process. The parent's
+# NARWHAL_PLACEHELD_PORTS advertisement is spawn-time static, so without
+# this set a second server in the same child (same node started twice, a
+# committee file assigning one port to two roles) would still co-bind
+# "through" an advertisement whose placeholder its sibling already consumed.
+_BOUND_IN_PROCESS: set[int] = set()
+
+
+def mark_port_bound(port: int) -> None:
+    """Record that a server in this process holds `port` (RpcServer.start)."""
+    _BOUND_IN_PROCESS.add(port)
+
+
+def mark_port_unbound(port: int) -> None:
+    """The server on `port` has stopped; a later bind (node restart) may
+    again co-bind through a parent's still-live placeholder."""
+    _BOUND_IN_PROCESS.discard(port)
+
+
 def port_is_placeheld(port: int) -> bool:
     """True when `port` is reserved by a live SO_REUSEPORT placeholder —
     this process's (_PLACEHOLDERS) or a harness parent's, advertised via
     NARWHAL_PLACEHELD_PORTS ("all", or a comma-separated port list). Servers
     use this to decide whether co-binding with reuse_port is intended
     (binding through a placeholder) or a misconfiguration that should fail
-    fast with EADDRINUSE (two servers on one address)."""
+    fast with EADDRINUSE (two servers on one address). A port already bound
+    by a live server in this process is never placeheld — the placeholder
+    behind any advertisement has done its job."""
+    if port in _BOUND_IN_PROCESS:
+        return False
     if port in _PLACEHOLDERS:
         return True
     env = os.environ.get("NARWHAL_PLACEHELD_PORTS", "")

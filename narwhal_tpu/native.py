@@ -19,24 +19,32 @@ logger = logging.getLogger("narwhal.native")
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_ROOT, "native", "storage_engine.cpp")
 _LIB = os.path.join(_ROOT, "native", "libnarwhal_storage.so")
+_SCALAR_SRC = os.path.join(_ROOT, "native", "scalar_ops.cpp")
+_SCALAR_LIB = os.path.join(_ROOT, "native", "libnarwhal_scalar.so")
 
 _lib: ctypes.CDLL | None = None
 _tried = False
+_scalar: ctypes.CDLL | None = None
+_scalar_tried = False
 
 
-def _build() -> bool:
+def _build_lib(src: str, lib: str, extra: list[str]) -> bool:
     try:
-        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        if os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(src):
             return True
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC, "-lz"],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", lib, src, *extra],
             check=True,
             capture_output=True,
         )
         return True
     except (OSError, subprocess.CalledProcessError) as e:
-        logger.warning("native storage engine build failed: %s", e)
+        logger.warning("native build of %s failed: %s", os.path.basename(src), e)
         return False
+
+
+def _build() -> bool:
+    return _build_lib(_SRC, _LIB, ["-lz"])
 
 
 def load() -> ctypes.CDLL | None:
@@ -91,6 +99,48 @@ def load() -> ctypes.CDLL | None:
     lib.nse_close.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
+
+
+def load_scalar() -> ctypes.CDLL | None:
+    """The ed25519 host scalar pipeline (native/scalar_ops.cpp), built on
+    demand; None when the toolchain is unavailable or NARWHAL_NATIVE=0.
+    ctypes releases the GIL for the call duration, so batched hashing and
+    mod-L arithmetic genuinely overlap device compute in the verify
+    pipeline."""
+    global _scalar, _scalar_tried
+    if _scalar_tried:
+        return _scalar
+    _scalar_tried = True
+    if os.environ.get("NARWHAL_NATIVE", "1") == "0":
+        return None
+    if not os.path.exists(_SCALAR_SRC) or not _build_lib(_SCALAR_SRC, _SCALAR_LIB, []):
+        return None
+    try:
+        lib = ctypes.CDLL(_SCALAR_LIB)
+    except OSError as e:
+        logger.warning("native scalar pipeline load failed: %s", e)
+        return None
+    lib.ed25519_precheck_k.restype = ctypes.c_int
+    lib.ed25519_precheck_k.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_char_p,  # pk rows
+        ctypes.c_char_p,  # sig rows
+        ctypes.c_char_p,  # msg buffer
+        ctypes.c_void_p,  # int64 offsets
+        ctypes.c_void_p,  # out k rows
+        ctypes.c_void_p,  # out ok bytes
+    ]
+    lib.scalar_fold.restype = None
+    lib.scalar_fold.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_void_p,  # k rows
+        ctypes.c_void_p,  # s rows
+        ctypes.c_char_p,  # z rows
+        ctypes.c_void_p,  # out ak rows
+        ctypes.c_void_p,  # out sum
+    ]
+    _scalar = lib
+    return _scalar
 
 
 class NativeEngine:

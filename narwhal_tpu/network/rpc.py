@@ -335,10 +335,14 @@ class RpcServer:
         # The allocator's placeholder has done its job once we hold the
         # listening socket; dropping it returns the fd (a long-lived
         # process building many clusters would otherwise hold up to a
-        # window's worth of placeholder fds against the ulimit).
-        from ..config import release_port
+        # window's worth of placeholder fds against the ulimit). Marking
+        # the port bound also strikes it from any parent's spawn-time
+        # NARWHAL_PLACEHELD_PORTS advertisement, so a second server on the
+        # same port in this process fails fast instead of co-binding.
+        from ..config import mark_port_bound, release_port
 
         release_port(bound)
+        mark_port_bound(bound)
         return bound
 
     @property
@@ -419,6 +423,10 @@ class RpcServer:
 
     async def stop(self) -> None:
         if self._server is not None:
+            try:
+                bound = self._server.sockets[0].getsockname()[1]
+            except (IndexError, OSError):
+                bound = None
             self._server.close()
             # Drop live connections: wait_closed() (3.12+) waits for every
             # connection handler, which would otherwise run until the peer
@@ -429,6 +437,12 @@ class RpcServer:
                 except Exception:
                     pass
             await self._server.wait_closed()
+            if bound is not None:
+                # A later bind of this port (node restart) may again
+                # co-bind through a parent's still-live placeholder.
+                from ..config import mark_port_unbound
+
+                mark_port_unbound(bound)
 
 
 class NetworkClient:

@@ -137,10 +137,16 @@ class PrimaryNode:
                 # Under the cofactored rule the device path is mandatory:
                 # a construction-failure fallback to the host library
                 # would silently run the strict accept set for the node's
-                # whole lifetime.
+                # whole lifetime — and a runtime dispatch-failure fallback
+                # would do the same intermittently. Safety beats liveness:
+                # with fallback disabled a persistent device failure makes
+                # verifications error (certs rejected, node effectively
+                # crash-faulty) instead of the node quietly switching
+                # accept sets (byzantine-faulty to the committee).
                 backend = make_batch_verifier(
                     mode="msm" if rule == "cofactored" else "item",
                     require=rule == "cofactored",
+                    fallback_on_error=rule != "cofactored",
                 )
             crypto_pool = AsyncVerifierPool(backend=backend)
         self.crypto_pool = crypto_pool

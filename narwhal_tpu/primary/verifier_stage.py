@@ -94,9 +94,21 @@ class VerifierStage:
             logger.debug("verifier stage dropped malformed message: %s", e)
             return
         if items:
-            results = await asyncio.gather(
-                *(self.pool.verify(pk, m, sig) for pk, m, sig in items)
-            )
+            try:
+                results = await asyncio.gather(
+                    *(self.pool.verify(pk, m, sig) for pk, m, sig in items)
+                )
+            except Exception:
+                # Backend dispatch failure with the host fallback disabled
+                # (cofactored committees: a strict-rule fallback would be a
+                # consensus-split hazard). Drop the message — conservative
+                # rejection affects liveness, never safety — and say so.
+                logger.exception(
+                    "verify backend failed; dropping %s (no host fallback "
+                    "under this committee's accept rule)",
+                    type(msg).__name__,
+                )
+                return
             if not all(results):
                 logger.warning(
                     "verifier stage rejected %s with bad signature",

@@ -35,6 +35,13 @@ _MIN_BUCKET = 16
 _MAX_BUCKET = 8192
 
 
+def _scalar_lib():
+    """The native host scalar pipeline, or None (pure-Python fallback)."""
+    from ..native import load_scalar
+
+    return load_scalar()
+
+
 def msm_epilogue_check(v_limbs: np.ndarray, sum_s: int, kernel) -> bool:
     """Host half of the batch check: Horner-collapse the device's
     per-window point sums and test [8]([Σ z_iS_i]B + Σ_w 16^(63-w) V_w)
@@ -117,68 +124,124 @@ class TpuVerifier:
         for size in sizes or (_MIN_BUCKET, self.max_bucket):
             self([(kp.public, b"warmup", sig)] * size)
 
-    def submit(self, items: Sequence[BatchItem]):
-        """Pack + precheck on host and enqueue the device dispatch(es).
-        Returns an opaque handle for `collect` — dispatch is asynchronous, so
-        several submitted batches stay in flight and the device readback
-        latency overlaps the next batch's host packing and compute."""
+    def _precheck_native(self, items: Sequence[BatchItem], lib):
+        """Batched canonicality checks + challenge scalars in C (GIL
+        released for the call): returns (precheck[n] bool, a_raw, r_raw,
+        s_raw, k_raw as uint8[n, 32])."""
         n = len(items)
-        if n == 0:
-            return (np.zeros(0, bool), np.zeros(0, np.int64), [], None, items)
-        ok = np.zeros(n, bool)
-        # Hot packing loop: list-append + one join per column — per-row
-        # numpy assignments cost ~3x more Python overhead per item, and at
-        # 100k+ items/s this loop IS the pipelined path's ceiling.
-        a_list: list[bytes] = []
-        r_list: list[bytes] = []
-        s_list: list[bytes] = []
-        k_list: list[bytes] = []
-        k_ints = [0] * n
-        s_ints = [0] * n
+        lenok = np.ones(n, bool)
+        pk_parts: list[bytes] = []
+        sig_parts: list[bytes] = []
+        msg_parts: list[bytes] = []
+        lens = np.empty(n, np.int64)
+        zero32, zero64 = b"\0" * 32, b"\0" * 64
+        for i, (pk, msg, sig) in enumerate(items):
+            if len(pk) != 32 or len(sig) != 64:
+                lenok[i] = False
+                pk_parts.append(zero32)
+                sig_parts.append(zero64)
+                lens[i] = 0
+                continue
+            pk_parts.append(pk)
+            sig_parts.append(sig)
+            msg_parts.append(msg)
+            lens[i] = len(msg)
+        pk_buf = b"".join(pk_parts)
+        sig_buf = b"".join(sig_parts)
+        offs = np.zeros(n + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        k_raw = np.empty((n, 32), np.uint8)
+        ok_raw = np.empty(n, np.uint8)
+        rc = lib.ed25519_precheck_k(
+            n,
+            pk_buf,
+            sig_buf,
+            b"".join(msg_parts),
+            offs.ctypes.data,
+            k_raw.ctypes.data,
+            ok_raw.ctypes.data,
+        )
+        if rc != 0:  # pragma: no cover - internal failure only
+            raise RuntimeError(f"ed25519_precheck_k failed: rc={rc}")
+        precheck = ok_raw.astype(bool) & lenok
+        sig_rows = np.frombuffer(sig_buf, np.uint8).reshape(n, 64)
+        a_raw = np.frombuffer(pk_buf, np.uint8).reshape(n, 32)
+        return precheck, a_raw, sig_rows[:, :32], sig_rows[:, 32:], k_raw
+
+    def _precheck_py(self, items: Sequence[BatchItem]):
+        """Pure-Python twin of `_precheck_native` (no-toolchain fallback);
+        bit-identical outputs — asserted by tests/test_tpu_ed25519.py."""
+        n = len(items)
         precheck = np.zeros(n, bool)
+        a_raw = np.zeros((n, 32), np.uint8)
+        r_raw = np.zeros((n, 32), np.uint8)
+        s_raw = np.zeros((n, 32), np.uint8)
+        k_raw = np.zeros((n, 32), np.uint8)
         L = self.kernel.ref.L
-        P_MASKED = self.kernel.ref.P
+        P = self.kernel.ref.P
         sha512 = hashlib.sha512
         top_mask = (1 << 255) - 1
+        frombuf = np.frombuffer
         for i, (pk, msg, sig) in enumerate(items):
             if len(pk) != 32 or len(sig) != 64:
                 continue
             rs, sb = sig[:32], sig[32:]
-            s_int = int.from_bytes(sb, "little")
-            if s_int >= L:
+            if int.from_bytes(sb, "little") >= L:
                 continue
-            if (int.from_bytes(pk, "little") & top_mask) >= P_MASKED:
+            if (int.from_bytes(pk, "little") & top_mask) >= P:
                 continue
-            if (int.from_bytes(rs, "little") & top_mask) >= P_MASKED:
+            if (int.from_bytes(rs, "little") & top_mask) >= P:
                 continue
             k_int = int.from_bytes(sha512(rs + pk + msg).digest(), "little") % L
-            a_list.append(pk)
-            r_list.append(rs)
-            s_list.append(sb)
-            k_list.append(k_int.to_bytes(32, "little"))
-            k_ints[i] = k_int
-            s_ints[i] = s_int
+            a_raw[i] = frombuf(pk, np.uint8)
+            r_raw[i] = frombuf(rs, np.uint8)
+            s_raw[i] = frombuf(sb, np.uint8)
+            k_raw[i] = frombuf(k_int.to_bytes(32, "little"), np.uint8)
             precheck[i] = True
+        return precheck, a_raw, r_raw, s_raw, k_raw
+
+    def submit(self, items: Sequence[BatchItem]):
+        """Pack + precheck on host and enqueue the device dispatch(es).
+        Returns an opaque handle for `collect` — dispatch is asynchronous, so
+        several submitted batches stay in flight and the device readback
+        latency overlaps the next batch's host packing and compute.
+
+        The per-item host work (SHA-512 challenge, canonicality checks,
+        msm scalars) runs in native/scalar_ops.cpp when available — the
+        Python loop it replaces was the pipelined path's ceiling (~250 ms
+        per 32k batch vs ~3 ms native)."""
+        n = len(items)
+        if n == 0:
+            return (np.zeros(0, bool), np.zeros(0, np.int64), [], None, items)
+        ok = np.zeros(n, bool)
+        lib = _scalar_lib()
+        if lib is not None:
+            precheck, a_all, r_all, s_all, k_all = self._precheck_native(items, lib)
+        else:
+            precheck, a_all, r_all, s_all, k_all = self._precheck_py(items)
 
         idx = np.flatnonzero(precheck)
         if idx.size == 0:
             return (ok, idx, [], None, items)
 
-        def rows(chunks: list[bytes]) -> np.ndarray:
-            return np.frombuffer(b"".join(chunks), np.uint8).reshape(-1, 32)
-
-        a_raw, r_raw = rows(a_list), rows(r_list)
+        # Compact to precheck-passing rows (contiguous for the C fold and
+        # the device upload).
+        a_raw = np.ascontiguousarray(a_all[idx])
+        r_raw = np.ascontiguousarray(r_all[idx])
+        s_raw = np.ascontiguousarray(s_all[idx])
+        k_raw = np.ascontiguousarray(k_all[idx])
         # Narrow upload dtypes (limbs < 2^13, digits < 16): ~3x fewer bytes
         # over the device link; the kernel widens to int32 lanes on device.
         a_y = self.kernel.bytes_to_limbs(a_raw).astype(np.int16)
         r_y = self.kernel.bytes_to_limbs(r_raw).astype(np.int16)
         a_sign = (a_raw[:, 31] >> 7).astype(np.int8)
         r_sign = (r_raw[:, 31] >> 7).astype(np.int8)
-        k_digits = self.kernel.bytes_to_digits(rows(k_list)).astype(np.int8)
-        s_digits = self.kernel.bytes_to_digits(rows(s_list)).astype(np.int8)
-        packed = (a_y, a_sign, r_y, r_sign, k_digits, s_digits)
+        # k/s digit planes are only needed by the per-item kernel — in msm
+        # mode that's the rare fallback path, so they're derived lazily in
+        # _dispatch_items instead of packed (and uploaded) eagerly.
+        packed = (a_y, a_sign, r_y, r_sign, k_raw, s_raw)
 
-        outs = []  # (kind, lo, hi, device out)
+        outs = []  # (kind, lo, hi, pad, device out)
         for lo in range(0, idx.size, self.max_bucket):
             hi = min(lo + self.max_bucket, idx.size)
             bucket = _MIN_BUCKET
@@ -187,9 +250,7 @@ class TpuVerifier:
             pad = bucket - (hi - lo)
 
             if self.mode == "msm" and bucket >= self.msm_min_bucket:
-                out = self._dispatch_msm(
-                    packed, idx, k_ints, s_ints, lo, hi, pad
-                )
+                out = self._dispatch_msm(packed, lo, hi, pad)
                 kind = "msm"
                 arrays = out[0]  # ((V, valid), sum_s)
             else:
@@ -208,7 +269,9 @@ class TpuVerifier:
         return (ok, idx, outs, packed, items)
 
     def _dispatch_items(self, packed, lo, hi, pad):
-        """Per-item Straus kernel over one padded bucket."""
+        """Per-item Straus kernel over one padded bucket (k/s scalar rows
+        are expanded to 4-bit digit planes here, on demand)."""
+        a_y, a_sign, r_y, r_sign, k_raw, s_raw = packed
 
         def pad_to(arr):
             if pad == 0:
@@ -217,29 +280,65 @@ class TpuVerifier:
                 [arr[lo:hi], np.repeat(arr[lo : lo + 1], pad, axis=0)]
             )
 
-        return self.kernel.verify_batch_kernel(*(pad_to(a) for a in packed))
+        k_digits = self.kernel.bytes_to_digits(pad_to(k_raw)).astype(np.int8)
+        s_digits = self.kernel.bytes_to_digits(pad_to(s_raw)).astype(np.int8)
+        return self.kernel.verify_batch_kernel(
+            pad_to(a_y), pad_to(a_sign), pad_to(r_y), pad_to(r_sign),
+            k_digits, s_digits,
+        )
 
-    def _dispatch_msm(self, packed, idx, k_ints, s_ints, lo, hi, pad):
-        """Random-linear-combination check over one bucket. Fresh 128-bit
-        z_i per item per call (os.urandom — the adversary must not predict
-        them); zero rows are inert padding. Host bignum work is ~3 modmuls
-        per item on Python ints. Returns (device (V, valid), sum_s) — the
-        Horner/identity epilogue runs on host at collect time."""
-        import os as _os
+    def _fold_native(self, lib, k_rows: np.ndarray, s_rows: np.ndarray, rnd: bytes):
+        """ak_i = z_i*k_i mod L and sum(z_i*s_i) mod L in C."""
+        m = k_rows.shape[0]
+        ak_raw = np.empty((m, 32), np.uint8)
+        sum_raw = np.empty(32, np.uint8)
+        lib.scalar_fold(
+            m,
+            k_rows.ctypes.data,
+            s_rows.ctypes.data,
+            rnd,
+            ak_raw.ctypes.data,
+            sum_raw.ctypes.data,
+        )
+        return ak_raw, int.from_bytes(sum_raw.tobytes(), "little")
 
+    def _fold_py(self, k_rows: np.ndarray, s_rows: np.ndarray, rnd: bytes):
+        """Python twin of `_fold_native` (identical outputs)."""
         L = self.kernel.ref.L
-        m = hi - lo
-        rnd = _os.urandom(16 * m)
+        m = k_rows.shape[0]
         from_bytes = int.from_bytes
+        kb, sb = k_rows.tobytes(), s_rows.tobytes()
         ak_parts: list[bytes] = []
         sum_s = 0
-        for t, j in enumerate(idx[lo:hi].tolist()):
+        for t in range(m):
             z = from_bytes(rnd[16 * t : 16 * (t + 1)], "little")
-            ak_parts.append(((z * k_ints[j]) % L).to_bytes(32, "little"))
-            sum_s += z * s_ints[j]
+            k = from_bytes(kb[32 * t : 32 * (t + 1)], "little")
+            s = from_bytes(sb[32 * t : 32 * (t + 1)], "little")
+            ak_parts.append(((z * k) % L).to_bytes(32, "little"))
+            sum_s += z * s
+        ak_raw = np.frombuffer(b"".join(ak_parts), np.uint8).reshape(m, 32)
+        return ak_raw, sum_s % L
+
+    def _dispatch_msm(self, packed, lo, hi, pad):
+        """Random-linear-combination check over one bucket. Fresh 128-bit
+        z_i per item per call (os.urandom — the adversary must not predict
+        them); zero rows are inert padding. Returns (device (V, valid),
+        sum_s) — the Horner/identity epilogue runs on host at collect
+        time."""
+        import os as _os
+
+        a_y, a_sign, r_y, r_sign, k_raw, s_raw = packed
+        m = hi - lo
+        rnd = _os.urandom(16 * m)
+        k_rows = np.ascontiguousarray(k_raw[lo:hi])
+        s_rows = np.ascontiguousarray(s_raw[lo:hi])
+        lib = _scalar_lib()
+        if lib is not None:
+            ak_raw, sum_s = self._fold_native(lib, k_rows, s_rows, rnd)
+        else:
+            ak_raw, sum_s = self._fold_py(k_rows, s_rows, rnd)
         if pad:
-            ak_parts.append(b"\0" * (32 * pad))
-        ak_raw = np.frombuffer(b"".join(ak_parts), np.uint8).reshape(-1, 32)
+            ak_raw = np.concatenate([ak_raw, np.zeros((pad, 32), np.uint8)])
         z_raw = np.zeros((m + pad, 32), np.uint8)
         z_raw[:m, :16] = np.frombuffer(rnd, np.uint8).reshape(m, 16)
 
@@ -254,12 +353,11 @@ class TpuVerifier:
                 [arr[lo:hi], np.zeros((pad,) + arr.shape[1:], arr.dtype)]
             )
 
-        a_y, a_sign, r_y, r_sign, _, _ = packed
         out = self.kernel.msm_accumulate_kernel(
             zpad(a_y), zpad(a_sign), zpad(r_y), zpad(r_sign),
             ak_digits, z_digits,
         )
-        return (out, sum_s % L)
+        return (out, sum_s)
 
     def collect(self, handle) -> list[bool]:
         """Materialize a `submit` handle's results (blocks on the device).

@@ -195,25 +195,45 @@ def test_cross_node_collection_fetch(run):
         cluster, client = await _api_cluster()
         try:
             a, b = cluster.authorities[0], cluster.authorities[1]
-            # B's newest causal collections...
-            rounds_b = await _wait_rounds(client, b.primary.api_address, b.name, 2)
-            nrc = await client.request(
-                b.primary.api_address,
-                NodeReadCausalRequest(b.name, rounds_b.newest_round),
-            )
-            assert nrc.digests
-            # ...fetched through A's API.
-            got = await client.request(
-                a.primary.api_address, GetCollectionsRequest(nrc.digests),
-                timeout=30.0,  # covers the server-side peer-sync window
-            )
-            assert len(got.results) == len(nrc.digests)
-            resolved = [r for r in got.results if r[2] == ""]
-            assert resolved, f"nothing resolved cross-node: {[r[2] for r in got.results]}"
-            # At least one resolved collection must carry real batches, so
-            # the fetch genuinely exercised payload retrieval rather than
-            # only empty timer-driven headers.
-            assert any(batches for _, batches, _ in resolved)
+            # Whether a given causal cut carries payload is a race between
+            # batch sealing and header proposal (headers seal on the
+            # max_header_delay timer even when payload-empty), so poll
+            # advancing rounds until a resolved collection has batches —
+            # sustaining load so later headers keep carrying payload.
+            target = cluster.authorities[0].worker_transactions_address(0)
+            deadline = asyncio.get_event_loop().time() + 60.0
+            want_round = 2
+            while True:
+                txs = tuple(bytes([9]) * 32 + bytes([i]) for i in range(16))
+                await client.request(target, SubmitTransactionStreamMsg(txs))
+                # B's newest causal collections...
+                rounds_b = await _wait_rounds(
+                    client, b.primary.api_address, b.name, want_round
+                )
+                nrc = await client.request(
+                    b.primary.api_address,
+                    NodeReadCausalRequest(b.name, rounds_b.newest_round),
+                )
+                assert nrc.digests
+                # ...fetched through A's API.
+                got = await client.request(
+                    a.primary.api_address, GetCollectionsRequest(nrc.digests),
+                    timeout=30.0,  # covers the server-side peer-sync window
+                )
+                assert len(got.results) == len(nrc.digests)
+                resolved = [r for r in got.results if r[2] == ""]
+                assert resolved, (
+                    f"nothing resolved cross-node: {[r[2] for r in got.results]}"
+                )
+                # At least one resolved collection must carry real batches,
+                # so the fetch genuinely exercised payload retrieval rather
+                # than only empty timer-driven headers.
+                if any(batches for _, batches, _ in resolved):
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (
+                    "no resolved collection ever carried batches"
+                )
+                want_round = rounds_b.newest_round + 1
         finally:
             client.close()
             await cluster.shutdown()

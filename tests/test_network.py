@@ -231,3 +231,32 @@ def test_placeheld_ports_env_enables_cobind(run, monkeypatch):
         pass
 
     run(noop(), timeout=5.0)
+
+
+def test_env_advertised_port_not_reusable_after_first_bind(run, monkeypatch):
+    """The parent's NARWHAL_PLACEHELD_PORTS advertisement is spawn-time
+    static; once a server in this process binds an advertised port, a
+    second server on the same port (same node started twice, one port
+    assigned to two roles) must fail fast instead of co-binding through
+    the stale advertisement."""
+    from narwhal_tpu.config import get_available_port, release_port
+    from narwhal_tpu.network.rpc import RpcServer
+
+    async def scenario():
+        port = get_available_port()
+        release_port(port)  # simulate: the placeholder lives in a parent
+        monkeypatch.setenv("NARWHAL_PLACEHELD_PORTS", str(port))
+        a = RpcServer()
+        await a.start("127.0.0.1", port)
+        b = RpcServer()
+        try:
+            with pytest.raises(OSError):
+                await b.start("127.0.0.1", port)
+        finally:
+            await a.stop()
+        # After stop, the advertisement applies again (node restart flow).
+        c = RpcServer()
+        await c.start("127.0.0.1", port)
+        await c.stop()
+
+    run(scenario(), timeout=30.0)

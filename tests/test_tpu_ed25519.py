@@ -260,3 +260,81 @@ def test_msm_torsion_defect_is_deterministic(msm_verifier):
     results2 = [msm_verifier(items2) for _ in range(3)]
     assert all(r == results2[0] for r in results2)
     assert results2[0] == [False] + [True] * 14
+
+
+def test_native_scalar_pipeline_matches_python():
+    """native/scalar_ops.cpp (batched SHA-512 challenge + canonicality
+    prechecks + msm fold scalars) must be bit-identical to the pure-Python
+    twin across valid, malformed and boundary inputs — the native path is
+    what the pipelined verifier runs in production."""
+    import os
+
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu.tpu.verifier import TpuVerifier, _scalar_lib
+
+    lib = _scalar_lib()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+
+    v = TpuVerifier(max_bucket=16)
+    kp = KeyPair.generate()
+    L = v.kernel.ref.L
+    P = v.kernel.ref.P
+    items = []
+    for i in range(64):
+        msg = os.urandom(i % 7 * 33)  # varied lengths incl. 0
+        sig = kp.sign(msg)
+        items.append((kp.public, msg, sig))
+    # Adversarial rows: wrong lengths, non-canonical s, non-canonical A/R
+    # encodings (y >= p under the masked top bit), corrupt signature.
+    items[3] = (b"short", items[3][1], items[3][2])
+    items[9] = (items[9][0], items[9][1], b"x" * 63)
+    bad_s = items[11][2][:32] + (L + 5).to_bytes(32, "little")
+    items[11] = (items[11][0], items[11][1], bad_s)
+    items[17] = ((P + 3).to_bytes(32, "little"), items[17][1], items[17][2])
+    bad_r = (2**255 - 1).to_bytes(32, "little") + items[23][2][32:]
+    items[23] = (items[23][0], items[23][1], bad_r)
+
+    pn, an, rn, sn, kn = v._precheck_native(items, lib)
+    pp, ap, rp, sp, kp_ = v._precheck_py(items)
+    assert (pn == pp).all()
+    assert not pn[3] and not pn[9] and not pn[11] and not pn[17] and not pn[23]
+    assert pn.sum() == 64 - 5
+    idx = pn.nonzero()[0]
+    assert (an[idx] == ap[idx]).all()
+    assert (kn[idx] == kp_[idx]).all()
+
+    import numpy as np
+
+    k_rows = np.ascontiguousarray(kn[idx])
+    s_rows = np.ascontiguousarray(sn[idx])
+    rnd = os.urandom(16 * len(idx))
+    ak_n, sum_n = v._fold_native(lib, k_rows, s_rows, rnd)
+    ak_p, sum_p = v._fold_py(k_rows, s_rows, rnd)
+    assert (ak_n == ak_p).all()
+    assert sum_n == sum_p
+
+
+def test_verifier_python_fallback_matches_native(monkeypatch):
+    """With NARWHAL_NATIVE disabled the verifier must produce the same
+    verdicts through the pure-Python packing path."""
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu import native as native_mod
+    from narwhal_tpu.tpu.verifier import TpuVerifier
+
+    kp = KeyPair.generate()
+    items = []
+    for i in range(20):
+        msg = b"m%d" % i
+        items.append((kp.public, msg, kp.sign(msg)))
+    items[4] = (items[4][0], items[4][1], items[4][2][:32] + b"\0" * 32)
+    items[8] = (b"", items[8][1], items[8][2])
+
+    v = TpuVerifier(max_bucket=16)
+    with_native = v(items)
+    monkeypatch.setattr(native_mod, "_scalar", None)
+    monkeypatch.setattr(native_mod, "_scalar_tried", True)
+    without = v(items)
+    assert with_native == without
+    assert not with_native[4] and not with_native[8]
+    assert sum(with_native) == 18
