@@ -56,6 +56,16 @@ class Parameters:
     #                unlocks the msm batch kernel. Nodes on cpu/pool
     #                backends refuse to start under this rule.
     verify_rule: str = "strict"
+    # Certificate wire form — committee-wide (mixed committees would
+    # disagree about certificate bytes):
+    #   full    — one 64-byte ed25519 signature per signer (reference-like).
+    #   compact — half-aggregated: 32-byte R per signer + one 32-byte
+    #             aggregate scalar (~2x smaller proofs, O(N) -> O(N)/2+32B;
+    #             see types.py Certificate). Verification is the msm
+    #             kernel's native equation; the host fallback is slow, so
+    #             compact committees should run --crypto-backend tpu.
+    #             Acceptance is inherently the cofactored rule.
+    cert_format: str = "full"
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)

@@ -130,6 +130,66 @@ class CertificateMsg:
         return CertificateMsg(Certificate.decode(r))
 
 
+@message(72)
+@dataclass
+class CertificateRefMsg:
+    """Compact-certificate broadcast WITHOUT the header body
+    (Parameters.cert_format="compact"): every peer that voted already
+    stores the header, so the announcement carries only its digest plus
+    the half-aggregated proof — cutting the dominant O(N) control-plane
+    bytes (header parents + per-signer signatures) from every certificate
+    broadcast. Receivers rebuild the Certificate from their header store
+    and fall back to fetching the full certificate from the origin
+    (CertificatesBatchRequest -> Helper) on miss. Replaces the capability
+    the reference gets from O(1) BLS certificates
+    (/root/reference/types/src/primary.rs:386-644)."""
+
+    header_digest: Digest
+    round: Round
+    epoch: Epoch
+    origin: PublicKey
+    signers: tuple[int, ...]
+    rs: tuple[bytes, ...]  # 32-byte nonce points
+    agg_s: bytes  # 32-byte aggregate scalar
+
+    @staticmethod
+    def from_certificate(cert: Certificate) -> "CertificateRefMsg":
+        assert cert.is_compact
+        return CertificateRefMsg(
+            cert.header.digest,
+            cert.round,
+            cert.epoch,
+            cert.origin,
+            cert.signers,
+            cert.signatures,
+            cert.agg_s,
+        )
+
+    def rebuild(self, header: Header) -> Certificate:
+        return Certificate(header, self.signers, self.rs, self.agg_s)
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.header_digest)
+        w.u64(self.round)
+        w.u64(self.epoch)
+        w.raw(self.origin)
+        w.seq(self.signers, lambda w_, i: w_.u32(i))
+        w.seq(self.rs, lambda w_, r: w_.raw(r))
+        w.raw(self.agg_s)
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificateRefMsg":
+        return CertificateRefMsg(
+            r.raw(DIGEST_LEN),
+            r.u64(),
+            r.u64(),
+            r.raw(PUBLIC_KEY_LEN),
+            tuple(r.seq(lambda r_: r_.u32())),
+            tuple(r.seq(lambda r_: r_.raw(32))),
+            r.raw(32),
+        )
+
+
 @message(4)
 @dataclass
 class CertificatesRequest:

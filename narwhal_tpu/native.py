@@ -139,6 +139,13 @@ def load_scalar() -> ctypes.CDLL | None:
         ctypes.c_void_p,  # out ak rows
         ctypes.c_void_p,  # out sum
     ]
+    lib.scalar_mulmod.restype = None
+    lib.scalar_mulmod.argtypes = [
+        ctypes.c_int64,
+        ctypes.c_void_p,  # a rows (32B)
+        ctypes.c_void_p,  # b rows (32B)
+        ctypes.c_void_p,  # out rows (32B)
+    ]
     _scalar = lib
     return _scalar
 

@@ -122,33 +122,44 @@ class PrimaryNode:
                 "tpu on every node, or set verify_rule=strict."
             )
         crypto_pool = None
-        if crypto_backend in ("pool", "tpu"):
-            from .tpu.verifier import AsyncVerifierPool, make_batch_verifier
+        if crypto_backend == "tpu":
+            from .tpu.verifier import AsyncVerifierPool, VerifyService
 
-            backend = None
-            if crypto_backend == "tpu":
-                if rule == "cofactored":
-                    logger.warning(
-                        "verify_rule=cofactored: EVERY node in this "
-                        "committee must run --crypto-backend tpu; a cpu/pool "
-                        "node (strict rule) in the same committee is a "
-                        "consensus-split hazard on crafted torsion signatures"
-                    )
-                # Under the cofactored rule the device path is mandatory:
-                # a construction-failure fallback to the host library
-                # would silently run the strict accept set for the node's
-                # whole lifetime — and a runtime dispatch-failure fallback
-                # would do the same intermittently. Safety beats liveness:
-                # with fallback disabled a persistent device failure makes
-                # verifications error (certs rejected, node effectively
-                # crash-faulty) instead of the node quietly switching
-                # accept sets (byzantine-faulty to the committee).
-                backend = make_batch_verifier(
-                    mode="msm" if rule == "cofactored" else "item",
-                    require=rule == "cofactored",
-                    fallback_on_error=rule != "cofactored",
+            if rule == "cofactored":
+                logger.warning(
+                    "verify_rule=cofactored: EVERY node in this "
+                    "committee must run --crypto-backend tpu; a cpu/pool "
+                    "node (strict rule) in the same committee is a "
+                    "consensus-split hazard on crafted torsion signatures"
                 )
-            crypto_pool = AsyncVerifierPool(backend=backend)
+            mode = "msm" if rule == "cofactored" else "item"
+            try:
+                # ONE pipelined service per process: every node on this
+                # host shares flushes, so the device link RTT is paid per
+                # merged batch, not per protocol hop (the VERDICT r3
+                # crypto=tpu stall at N=20).
+                crypto_pool = VerifyService.shared(mode)
+            except Exception:
+                # Under the cofactored rule the device path is mandatory: a
+                # host fallback would run the STRICT accept set — a
+                # consensus-split hazard (safety beats liveness; the node
+                # refuses to start instead). Strict-rule nodes degrade to
+                # the host pool, which implements the same accept set.
+                if rule == "cofactored":
+                    raise RuntimeError(
+                        "TPU verifier unavailable but the committee's "
+                        "verify rule requires it (host fallback implements "
+                        "a different accept set); refusing to start"
+                    )
+                logger.exception(
+                    "TPU verifier unavailable; degrading to the host pool "
+                    "(same strict accept set)"
+                )
+                crypto_pool = AsyncVerifierPool()
+        elif crypto_backend == "pool":
+            from .tpu.verifier import AsyncVerifierPool
+
+            crypto_pool = AsyncVerifierPool()
         self.crypto_pool = crypto_pool
 
         self.primary = Primary(

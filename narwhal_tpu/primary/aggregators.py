@@ -18,11 +18,12 @@ class VotesAggregator:
     author's implicit self-vote) reaches quorum
     (/root/reference/primary/src/aggregators.rs:16-57)."""
 
-    def __init__(self) -> None:
+    def __init__(self, cert_format: str = "full") -> None:
         self.weight = 0
         self.votes: list[tuple[int, bytes]] = []  # (committee index, signature)
         self.seen: set[bytes] = set()  # voter public keys
         self.done = False
+        self.cert_format = cert_format
 
     def append(
         self, vote: Vote, committee: Committee, header: Header
@@ -35,6 +36,13 @@ class VotesAggregator:
         if self.weight >= committee.quorum_threshold():
             self.done = True
             signers, sigs = zip(*sorted(self.votes))
+            if self.cert_format == "compact":
+                # Half-aggregate: ~32 bytes/signer instead of 64, and the
+                # proof verifies as one msm-kernel equation (types.py
+                # Certificate docstring; Parameters.cert_format).
+                return Certificate.compact_from_votes(
+                    header, tuple(signers), tuple(sigs)
+                )
             return Certificate(header, tuple(signers), tuple(sigs))
         return None
 

@@ -58,6 +58,7 @@ class Core:
         gc_depth: Round,
         rx_reconfigure: Watch,
         metrics=None,
+        cert_format: str = "full",  # full | compact (Parameters.cert_format)
     ):
         self.name = name
         self.committee = committee
@@ -82,7 +83,8 @@ class Core:
         self.gc_round: Round = 0
         self.highest_received_round: Round = 0
         self.current_header: Header | None = None
-        self.votes_aggregator = VotesAggregator()
+        self.cert_format = cert_format
+        self.votes_aggregator = VotesAggregator(cert_format)
         self.certificates_aggregators: dict[Round, CertificatesAggregator] = {}
         self.processing: dict[Round, set[Digest]] = {}
         # Reliable-send handles by round, dropped (cancelled) at GC so a dead
@@ -101,7 +103,7 @@ class Core:
     # ------------------------------------------------------------------
     async def process_own_header(self, header: Header) -> None:
         self.current_header = header
-        self.votes_aggregator = VotesAggregator()
+        self.votes_aggregator = VotesAggregator(self.cert_format)
         from ..messages import HeaderMsg
 
         addresses = [addr for _, addr, _ in self.committee.others_primaries(self.name)]
@@ -195,12 +197,20 @@ class Core:
             )
             if self.metrics is not None:
                 self.metrics.certificates_created.inc()
-            from ..messages import CertificateMsg
+            from ..messages import CertificateMsg, CertificateRefMsg
 
             addresses = [
                 addr for _, addr, _ in self.committee.others_primaries(self.name)
             ]
-            handlers = self.network.broadcast(addresses, CertificateMsg(certificate))
+            # Compact certificates broadcast by reference: peers hold the
+            # header already (they voted on it), so the announcement omits
+            # the header body (messages.CertificateRefMsg).
+            msg = (
+                CertificateRefMsg.from_certificate(certificate)
+                if certificate.is_compact
+                else CertificateMsg(certificate)
+            )
+            handlers = self.network.broadcast(addresses, msg)
             self.cancel_handlers.setdefault(certificate.round, []).extend(handlers)
             await self.process_certificate(certificate)
 
@@ -276,8 +286,8 @@ class Core:
             )
         if preverified:
             # Signatures checked by the verifier stage; re-run only the
-            # structural/stake checks.
-            certificate.verify_items(self.committee)
+            # structural/stake checks (no message/weight recomputation).
+            certificate.structural_verify(self.committee)
         else:
             certificate.verify(self.committee, self.worker_cache)
 
@@ -393,7 +403,7 @@ class Core:
         self.gc_round = 0
         self.highest_received_round = 0
         self.current_header = None
-        self.votes_aggregator = VotesAggregator()
+        self.votes_aggregator = VotesAggregator(self.cert_format)
         self.certificates_aggregators.clear()
         self.processing.clear()
         # Rounds restart at 0: the persistent per-author vote guard must be

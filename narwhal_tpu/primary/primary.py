@@ -110,6 +110,9 @@ class Primary:
         self.tx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
         self.tx_consensus_round_updates: Watch = Watch(0)
 
+        self.header_store = storage.header_store
+        self._ref_tasks: set[asyncio.Task] = set()  # certificate-ref resolvers
+
         genesis = {c.digest: c for c in Certificate.genesis(committee)}
         genesis_digests = frozenset(genesis)
         self.synchronizer = Synchronizer(
@@ -142,6 +145,7 @@ class Primary:
             parameters.gc_depth,
             self.tx_reconfigure,
             self.metrics,
+            cert_format=getattr(parameters, "cert_format", "full"),
         )
         self.core.tx_certificate_waiter = self.tx_sync_certificates
         self.proposer = Proposer(
@@ -223,6 +227,11 @@ class Primary:
         self.server.route(HeaderMsg, self._on_header, allow=allow_peer_primary)
         self.server.route(VoteMsg, self._on_vote, allow=allow_peer_primary)
         self.server.route(CertificateMsg, self._on_certificate, allow=allow_peer_primary)
+        from ..messages import CertificateRefMsg
+
+        self.server.route(
+            CertificateRefMsg, self._on_certificate_ref, allow=allow_peer_primary
+        )
         self.server.route(
             CertificatesBatchRequest,
             self.helper.on_certificates_batch,
@@ -299,6 +308,63 @@ class Primary:
         await self._ingest(msg.certificate)
         return None
 
+    async def _on_certificate_ref(self, msg, peer: str):
+        """Compact-certificate announcement: rebuild from our header store
+        (we voted on the header, so the common case is a local hit), or
+        fetch the full certificate from the origin on miss via the Helper's
+        batch route. Runs as a task so a fetch RTT never blocks the
+        connection's dispatch loop."""
+        header = self.header_store.read(msg.header_digest)
+        if header is None:
+            task = asyncio.ensure_future(self._resolve_certificate_ref(msg))
+            self._ref_tasks.add(task)
+            task.add_done_callback(self._ref_tasks.discard)
+            return None
+        if (
+            header.round == msg.round
+            and header.epoch == msg.epoch
+            and header.author == msg.origin
+        ):
+            await self._ingest(msg.rebuild(header))
+        return None
+
+    async def _resolve_certificate_ref(self, msg) -> None:
+        from ..crypto import digest256
+        from ..messages import CertificatesBatchRequest
+
+        # Brief grace for the in-flight HeaderMsg to land before paying a
+        # fetch round trip.
+        try:
+            header = await asyncio.wait_for(
+                self.header_store.notify_read(msg.header_digest), timeout=0.5
+            )
+        except asyncio.TimeoutError:
+            header = None
+        if header is not None:
+            if (
+                header.round == msg.round
+                and header.epoch == msg.epoch
+                and header.author == msg.origin
+            ):
+                await self._ingest(msg.rebuild(header))
+            return
+        # The certificate digest is derived from the header digest alone
+        # (types.Certificate.digest), so the fetch key is computable.
+        cert_digest = digest256(b"CERT" + msg.header_digest)
+        try:
+            address = self.committee.primary_address(msg.origin)
+            resp = await self.network.request(
+                address,
+                CertificatesBatchRequest((cert_digest,), self.name),
+                timeout=5.0,
+            )
+        except Exception as e:
+            logger.debug("certificate-ref fetch from origin failed: %s", e)
+            return
+        for _, cert in getattr(resp, "certificates", ()) or ():
+            if cert is not None:
+                await self._ingest(cert)
+
     async def _on_our_batch(self, msg: OurBatchMsg, peer: str):
         await self.tx_our_digests.send((msg.digest, msg.worker_id))
         return None
@@ -318,6 +384,8 @@ class Primary:
         self.tx_reconfigure.send(ReconfigureNotification("shutdown"))
         if self.verifier_stage is not None:
             self.verifier_stage.shutdown()
+        for t in list(self._ref_tasks):
+            t.cancel()
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)

@@ -73,6 +73,7 @@ class VerifierStage:
         task.add_done_callback(_done)
 
     async def _verify(self, msg) -> None:
+        agg_group = None
         try:
             if isinstance(msg, Header):
                 msg.verify(self.committee, self.worker_cache, check_signature=False)
@@ -80,6 +81,16 @@ class VerifierStage:
             elif isinstance(msg, Vote):
                 msg.verify(self.committee, check_signature=False)
                 items = [msg.signature_item()]
+            elif isinstance(msg, Certificate) and msg.is_compact:
+                # Half-aggregated proof: one aggregate check for the vote
+                # quorum + the embedded header's own signature.
+                agg_group = msg.aggregate_group(self.committee)
+                items = []
+                if agg_group is not None:
+                    msg.header.verify(
+                        self.committee, self.worker_cache, check_signature=False
+                    )
+                    items.append(msg.header.signature_item())
             elif isinstance(msg, Certificate):
                 items = msg.verify_items(self.committee)
                 if items:
@@ -93,11 +104,12 @@ class VerifierStage:
         except DagError as e:
             logger.debug("verifier stage dropped malformed message: %s", e)
             return
-        if items:
+        if items or agg_group is not None:
             try:
-                results = await asyncio.gather(
-                    *(self.pool.verify(pk, m, sig) for pk, m, sig in items)
-                )
+                awaitables = [self.pool.verify(pk, m, sig) for pk, m, sig in items]
+                if agg_group is not None:
+                    awaitables.append(self.pool.verify_aggregate(*agg_group))
+                results = await asyncio.gather(*awaitables)
             except Exception:
                 # Backend dispatch failure with the host fallback disabled
                 # (cofactored committees: a strict-rule fallback would be a

@@ -367,6 +367,61 @@ def vote_digest(
 # Certificate
 # ---------------------------------------------------------------------------
 
+# Domain separator for the half-aggregation Fiat-Shamir weights. Versioned:
+# changing anything about the transcript encoding must change this tag.
+_AGG_DOMAIN = b"narwhal-tpu-halfagg-v1"
+
+
+def aggregate_weights(
+    header_digest: Digest, signers: tuple[int, ...], rs: tuple[bytes, ...]
+) -> list[int]:
+    """128-bit Fiat-Shamir weights z_i for certificate half-aggregation,
+    bound to the whole transcript (header digest, signer set, every nonce
+    point R_i). Deterministic, so verifier and aggregator agree; transcript-
+    bound, so an adversary cannot craft per-signature errors that cancel —
+    the soundness argument of Schnorr/EdDSA half-aggregation (Chalkias,
+    Garillot, Kondi, Nikolaenko: "Non-interactive half-aggregation of EdDSA
+    and variants", public construction; original implementation)."""
+    import hashlib
+
+    w = Writer()
+    w.raw(_AGG_DOMAIN)
+    w.raw(header_digest)
+    w.seq(signers, lambda w_, i: w_.u32(i))
+    w.seq(rs, lambda w_, r: w_.raw(r))
+    base = hashlib.sha512(w.finish()).digest()
+    return [
+        int.from_bytes(
+            hashlib.sha512(base + i.to_bytes(4, "little")).digest()[:16], "little"
+        )
+        for i in range(len(signers))
+    ]
+
+
+def host_verify_aggregate(
+    items: list[tuple[bytes, bytes, bytes]], zs: list[int], agg_s: int
+) -> bool:
+    """Host (pure-Python) check of a half-aggregated certificate:
+    [8]([agg_s]B - sum([z_i k_i]A_i) - sum([z_i]R_i)) == identity, with
+    k_i = SHA512(R_i || A_i || m_i) mod L. Cofactored, matching the device
+    msm rule. Slow (~one scalar-mul per term) — the production path is the
+    TPU verifier's aggregate lane; this serves the cpu backend and tests."""
+    from .tpu import ed25519_ref as ref
+
+    acc = ref.IDENTITY
+    for (pk, msg, r_bytes), z in zip(items, zs):
+        a = ref.decompress(pk)
+        r = ref.decompress(r_bytes)
+        if a is None or r is None:
+            return False
+        k = ref.sha512_mod_l(r_bytes, pk, msg)
+        acc = ref.point_add(acc, ref.point_mul(z * k % ref.L, a))
+        acc = ref.point_add(acc, ref.point_mul(z % ref.L, r))
+    acc = ref.point_add(ref.point_mul(agg_s % ref.L, ref.G), ref.point_neg(acc))
+    for _ in range(3):  # cofactor 8
+        acc = ref.point_double(acc)
+    return ref.point_equal(acc, ref.IDENTITY)
+
 
 @dataclass(frozen=True)
 class Certificate:
@@ -376,11 +431,33 @@ class Certificate:
     committee-indices (sorted) and the matching ed25519 vote signatures —
     batch-verifiable in one TPU call. The certificate digest depends only on
     the header (as in the reference), so certificates assembled from different
-    vote subsets dedup to the same identity."""
+    vote subsets dedup to the same identity.
+
+    Two wire forms (the `agg_s` field discriminates):
+
+    - FULL: `signatures[i]` is signer i's 64-byte ed25519 vote signature.
+    - COMPACT (half-aggregated, Parameters.cert_format="compact"): the
+      per-vote scalars s_i are collapsed into one 32-byte `agg_s` =
+      sum(z_i * s_i) mod L under Fiat-Shamir weights z_i bound to the whole
+      transcript (aggregate_weights), and `signatures[i]` keeps only the
+      32-byte R_i nonce point. This is Schnorr/EdDSA half-aggregation: the
+      proof shrinks from 64 to ~32 bytes per signer — the capability the
+      reference gets from BLS aggregation (O(1) certs,
+      /root/reference/crypto/src/bls12377/mod.rs:45-120), recovered
+      TPU-first: the verification equation
+        [8]([agg_s]B - sum([z_i k_i]A_i) - sum([z_i]R_i)) == identity
+      is EXACTLY the random-linear-combination shape the msm batch kernel
+      computes, so devices verify compact certificates natively (and many
+      of them fused in one dispatch under an outer random combination)."""
 
     header: Header
     signers: tuple[int, ...] = ()
     signatures: tuple[bytes, ...] = ()
+    agg_s: bytes = b""
+
+    @property
+    def is_compact(self) -> bool:
+        return len(self.agg_s) == 32
 
     @property
     def round(self) -> Round:
@@ -404,12 +481,25 @@ class Certificate:
     def encode(self, w: Writer) -> None:
         self.header.encode(w)
         w.seq(self.signers, lambda w_, i: w_.u32(i))
-        w.seq(self.signatures, lambda w_, s: w_.raw(s))
+        if self.is_compact:
+            w.u8(1)
+            w.seq(self.signatures, lambda w_, s: w_.raw(s))  # 32B R_i each
+            w.raw(self.agg_s)
+        else:
+            w.u8(0)
+            w.seq(self.signatures, lambda w_, s: w_.raw(s))
 
     @staticmethod
     def decode(r: Reader) -> "Certificate":
         header = Header.decode(r)
         signers = tuple(r.seq(lambda r_: r_.u32()))
+        form = r.u8()
+        if form == 1:
+            rs = tuple(r.seq(lambda r_: r_.raw(32)))
+            agg_s = r.raw(32)
+            return Certificate(header, signers, rs, agg_s)
+        if form != 0:
+            raise CodecError(f"unknown certificate form {form}")
         sigs = tuple(r.seq(lambda r_: r_.raw(SIGNATURE_LEN)))
         return Certificate(header, signers, sigs)
 
@@ -439,41 +529,120 @@ class Certificate:
     def is_genesis(self) -> bool:
         return self.round == 0
 
-    def verify_items(self, committee) -> list[tuple[bytes, bytes, bytes]]:
-        """Structural checks + return the (pubkey, message, signature) batch
-        to verify. Mirrors Certificate::verify
-        (/root/reference/types/src/primary.rs:487-537): epoch, quorum stake of
-        signers, then the signature check — here a batch of per-voter ed25519
-        verifies instead of one aggregate-verify."""
+    def _signer_checks(self, committee) -> list[bytes] | None:
+        """Shared structural checks: epoch, genesis well-formedness, arity,
+        duplicate signers, index range, quorum stake. Returns the signer
+        public keys in order (None for genesis)."""
         if self.epoch != committee.epoch:
             raise InvalidEpoch(f"certificate epoch {self.epoch} != {committee.epoch}")
         if self.is_genesis():
             if self not in Certificate.genesis(committee):
                 raise DagError("malformed genesis certificate")
-            return []
+            return None
         if len(self.signers) != len(self.signatures):
             raise DagError("signer/signature arity mismatch")
         if len(set(self.signers)) != len(self.signers):
             raise DagError("duplicate signers")
         keys = committee.authority_keys()
+        pks = []
         stake = 0
-        items = []
-        for idx, sig in zip(self.signers, self.signatures):
+        for idx in self.signers:
             if idx >= len(keys):
                 raise DagError(f"signer index {idx} out of range")
             pk = keys[idx]
             stake += committee.stake(pk)
-            msg = vote_digest(
-                self.header.digest, self.round, self.epoch, self.origin, pk
-            )
-            items.append((pk, msg, sig))
+            pks.append(pk)
         if stake < committee.quorum_threshold():
             raise QuorumNotReached(
                 f"certificate carries {stake} stake < quorum {committee.quorum_threshold()}"
             )
-        return items
+        return pks
+
+    def structural_verify(self, committee) -> None:
+        """Only the structural/stake checks (epoch, arity, duplicate
+        signers, quorum) — for callers whose signatures were already
+        batch-verified elsewhere (the Core's preverified path). Works for
+        both wire forms without recomputing messages or Fiat-Shamir
+        weights."""
+        self._signer_checks(committee)
+
+    def verify_items(self, committee) -> list[tuple[bytes, bytes, bytes]]:
+        """Structural checks + return the (pubkey, message, signature) batch
+        to verify. Mirrors Certificate::verify
+        (/root/reference/types/src/primary.rs:487-537): epoch, quorum stake of
+        signers, then the signature check — here a batch of per-voter ed25519
+        verifies instead of one aggregate-verify. FULL form only; compact
+        certificates expose `aggregate_group` instead."""
+        if self.is_compact:
+            raise DagError("compact certificate has no per-item signatures")
+        pks = self._signer_checks(committee)
+        if pks is None:
+            return []
+        return [
+            (
+                pk,
+                vote_digest(
+                    self.header.digest, self.round, self.epoch, self.origin, pk
+                ),
+                sig,
+            )
+            for pk, sig in zip(pks, self.signatures)
+        ]
+
+    def aggregate_group(
+        self, committee
+    ) -> tuple[list[tuple[bytes, bytes, bytes]], list[int], int] | None:
+        """Structural checks + the half-aggregation verification group:
+        ([(pubkey, message, R)], fiat-shamir weights z_i, agg scalar). None
+        for genesis. The check to perform is
+          [8]([agg_s]B - sum([z_i k_i]A_i) - sum([z_i]R_i)) == identity
+        with k_i = SHA512(R_i || A_i || m_i) mod L."""
+        if not self.is_compact:
+            raise DagError("aggregate_group on a full certificate")
+        pks = self._signer_checks(committee)
+        if pks is None:
+            return None
+        zs = aggregate_weights(self.header.digest, self.signers, self.signatures)
+        items = [
+            (
+                pk,
+                vote_digest(
+                    self.header.digest, self.round, self.epoch, self.origin, pk
+                ),
+                r,
+            )
+            for pk, r in zip(pks, self.signatures)
+        ]
+        return items, zs, int.from_bytes(self.agg_s, "little")
+
+    @staticmethod
+    def compact_from_votes(
+        header: "Header",
+        signers: tuple[int, ...],
+        signatures: tuple[bytes, ...],
+    ) -> "Certificate":
+        """Half-aggregate a quorum of full 64-byte vote signatures into a
+        compact certificate (the assembly-side counterpart of
+        `aggregate_group`; Parameters.cert_format="compact")."""
+        from .tpu.ed25519_ref import L
+
+        rs = tuple(sig[:32] for sig in signatures)
+        zs = aggregate_weights(header.digest, signers, rs)
+        agg = 0
+        for z, sig in zip(zs, signatures):
+            agg += z * int.from_bytes(sig[32:64], "little")
+        return Certificate(header, signers, rs, (agg % L).to_bytes(32, "little"))
 
     def verify(self, committee, worker_cache) -> None:
+        if self.is_compact:
+            group = self.aggregate_group(committee)
+            if group is None:
+                return
+            self.header.verify(committee, worker_cache)
+            items, zs, agg_s = group
+            if not host_verify_aggregate(items, zs, agg_s):
+                raise InvalidSignatureError("aggregate certificate proof invalid")
+            return
         items = self.verify_items(committee)
         if not items:
             return

@@ -315,6 +315,20 @@ void scalar_fold(int64_t m, const uint8_t *k_rows, const uint8_t *s_rows,
   memcpy(out_sum, acc, 32);
 }
 
+// Pairwise 256-bit modular multiply: out_i = a_i * b_i mod L. Used by the
+// aggregate-certificate lane (y_i = w_g * z_i, then y_i * k_i) where the
+// scalars exceed the 128-bit z lane scalar_fold handles.
+void scalar_mulmod(int64_t m, const uint8_t *a_rows, const uint8_t *b_rows,
+                   uint8_t *out_rows) {
+  for (int64_t i = 0; i < m; ++i) {
+    u64 a[4], b[4], o[4];
+    memcpy(a, a_rows + 32 * i, 32);
+    memcpy(b, b_rows + 32 * i, 32);
+    mulmod_l(a, 4, b, 4, o);
+    memcpy(out_rows + 32 * i, o, 32);
+  }
+}
+
 // Self-test hook: reduce one nx-limb value mod L (nx <= 9).
 void reduce_mod_l_test(const uint8_t *x, int64_t nx, uint8_t *out) {
   u64 xl[9], o[4];

@@ -63,6 +63,11 @@ def _golden_messages():
         M.HeaderMsg: M.HeaderMsg(header),
         M.VoteMsg: M.VoteMsg(vote),
         M.CertificateMsg: M.CertificateMsg(cert),
+        M.CertificateRefMsg: M.CertificateRefMsg.from_certificate(
+            Certificate.compact_from_votes(
+                header, cert.signers, cert.signatures
+            )
+        ),
         M.CertificatesRequest: M.CertificatesRequest((d1, d2), pk),
         M.CertificatesBatchRequest: M.CertificatesBatchRequest((d1,), pk),
         M.CertificatesBatchResponse: M.CertificatesBatchResponse(

@@ -372,3 +372,203 @@ def test_verify_rule_validated_at_startup(tmp_path):
             replace(fx.parameters, verify_rule="bogus"),
             NodeStorage(None),
         )
+
+
+def test_cluster_with_tpu_crypto_shared_service(run):
+    """crypto_backend="tpu": the whole committee shares ONE process-wide
+    VerifyService (merged flushes, pipelined submit/collect threads) —
+    certificates verify through the device kernel path and commits advance
+    (on conftest's CPU devices; the real-chip twin is the round artifact).
+
+    The service is pre-seeded with a small-bucket verifier and warmed: on
+    this 1-core CPU host an in-protocol first compile would eat the whole
+    progress window (production pays this once at boot, inside the bench's
+    warmup_timeout)."""
+    from narwhal_tpu.tpu.verifier import TpuVerifier, VerifyService
+
+    svc = VerifyService(
+        TpuVerifier(max_bucket=32, msm_min_bucket=16, mode="msm"),
+        max_batch=32,
+        max_delay=0.002,
+    )
+    svc.verifier.precompile((16, 32))
+    VerifyService._shared["msm"] = svc
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1, crypto_backend="tpu")
+        assert cluster.parameters.verify_rule == "cofactored"
+        await cluster.start()
+        try:
+            rounds = await cluster.assert_progress(commit_threshold=2, timeout=180.0)
+            assert all(r >= 2 for r in rounds.values())
+            # Every node's pool is the same process-wide service.
+            pools = {id(a.primary.crypto_pool) for a in cluster.authorities}
+            assert len(pools) == 1
+            assert cluster.authorities[0].primary.crypto_pool is svc
+        finally:
+            await cluster.shutdown()
+
+    try:
+        run(scenario(), timeout=300.0)
+    finally:
+        svc.shutdown()
+
+
+def test_verify_service_merges_and_survives_loops(run):
+    """VerifyService is loop-agnostic: requests from sequential event loops
+    resolve correctly, bad signatures are rejected, and an msm-mode service
+    propagates dispatch failures instead of host-fallback (accept-set
+    safety)."""
+    import asyncio
+
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu.tpu.verifier import TpuVerifier, VerifyService
+
+    kp = KeyPair.generate()
+    good = (kp.public, b"m", kp.sign(b"m"))
+    bad = (kp.public, b"x", kp.sign(b"m"))
+    svc = VerifyService(
+        TpuVerifier(max_bucket=64, msm_min_bucket=16, mode="msm"),
+        max_batch=64,
+        max_delay=0.002,
+    )
+    try:
+        async def burst():
+            return await asyncio.gather(
+                *(svc.verify(*good) for _ in range(20)), svc.verify(*bad)
+            )
+
+        # Two separate loops back to back — the service must serve both.
+        res1 = asyncio.run(burst())
+        res2 = asyncio.run(burst())
+        for res in (res1, res2):
+            assert res[:-1] == [True] * 20 and res[-1] is False
+
+        # Dispatch failure with no safe fallback (msm): error propagates.
+        def boom(items):
+            raise RuntimeError("device lost")
+
+        svc.verifier.submit = boom  # type: ignore[assignment]
+        async def failing():
+            with pytest.raises(RuntimeError, match="device lost"):
+                await svc.verify(*good)
+
+        asyncio.run(failing())
+    finally:
+        svc.shutdown()
+
+
+def test_byzantine_peer_equivocation_and_stale_epoch(run, caplog):
+    """A committee member gone byzantine: it equivocates (two validly signed
+    round-1 headers with different parent sets) and replays a wrong-epoch
+    header, from an authenticated mesh identity. The equivocation guard
+    (primary/core.py process_header; core.rs:281-308) must trigger
+    observably — the first header's vote digest stays recorded, the second
+    is refused with a logged warning — the stale-epoch header is dropped,
+    and the honest quorum keeps committing throughout. This exercises
+    adversarial-peer behavior the reference's cluster tests never do (they
+    are crash-fault only, test_utils/src/cluster.rs:169)."""
+    import logging
+
+    from narwhal_tpu.network import Credentials, committee_resolver
+    from narwhal_tpu.types import Certificate, Header
+
+    caplog.set_level(logging.DEBUG, logger="narwhal.primary")
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1)
+        byz = cluster.fixture.authorities[3]
+        await cluster.start(3)  # the byzantine member never runs a node
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=60.0)
+
+            client = NetworkClient(
+                credentials=Credentials(
+                    byz.network_keypair,
+                    committee_resolver(
+                        lambda: cluster.committee, lambda: cluster.worker_cache
+                    ),
+                )
+            )
+            from narwhal_tpu.messages import HeaderMsg
+
+            genesis = sorted(
+                c.digest for c in Certificate.genesis(cluster.committee)
+            )
+            epoch = cluster.committee.epoch
+            # Two quorum-sized but different parent subsets => two distinct,
+            # validly signed headers for the same (author, round).
+            h1 = Header.build(byz.public, 1, epoch, {}, genesis[:3], byz.keypair)
+            h2 = Header.build(byz.public, 1, epoch, {}, genesis[1:], byz.keypair)
+            assert h1.digest != h2.digest
+            target = cluster.authorities[0].primary.address
+            await client.unreliable_send(target, HeaderMsg(h1))
+            await asyncio.sleep(1.0)
+            await client.unreliable_send(target, HeaderMsg(h2))
+            # Wrong-epoch replay: validly signed, stale epoch.
+            h3 = Header.build(byz.public, 1, epoch + 7, {}, genesis[:3], byz.keypair)
+            await client.unreliable_send(target, HeaderMsg(h3))
+            await asyncio.sleep(1.0)
+            client.close()
+
+            # The guard recorded the FIRST header's vote and refused the
+            # equivocating twin, loudly.
+            store = cluster.authorities[0].primary.storage.vote_digest_store
+            last = store.read(byz.public)
+            assert last is not None and last == (1, h1.digest)
+            primary_logs = [
+                r.getMessage()
+                for r in caplog.records
+                if r.name.startswith("narwhal.primary")
+            ]
+            assert any("equivocated" in m for m in primary_logs), primary_logs[-20:]
+            assert any("stale" in m.lower() for m in primary_logs), primary_logs[-20:]
+
+            # Liveness: the honest quorum keeps committing after the attack.
+            rounds = await cluster.assert_progress(commit_threshold=4, timeout=60.0)
+            assert all(r >= 4 for r in rounds.values())
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=180.0)
+
+
+def test_cluster_with_compact_certificates(run, tmp_path):
+    """Parameters.cert_format="compact": certificates assemble as
+    half-aggregated proofs, broadcast by reference (CertificateRefMsg,
+    header by digest), peers rebuild them from their header stores, and
+    the committee commits transactions with identical order. The pool
+    backend exercises the host aggregate-verify path end-to-end."""
+    from dataclasses import replace
+
+    from narwhal_tpu.config import Parameters
+
+    async def scenario():
+        cluster = Cluster(
+            size=4,
+            workers=1,
+            store_base=str(tmp_path),
+            crypto_backend="pool",
+            parameters=Parameters(
+                max_header_delay=0.1,
+                max_batch_delay=0.1,
+                cert_format="compact",
+            ),
+        )
+        await cluster.start()
+        try:
+            rounds = await cluster.assert_progress(commit_threshold=3, timeout=90.0)
+            assert all(r >= 3 for r in rounds.values())
+            # The stored certificates really are the compact form.
+            store = cluster.authorities[0].primary.storage.certificate_store
+            compact_seen = 0
+            for other in cluster.authorities[1:]:
+                for cert in store.after_round(1):
+                    if cert.origin == other.name and cert.is_compact:
+                        compact_seen += 1
+                        break
+            assert compact_seen >= 2, "peers' certificates not compact"
+        finally:
+            await cluster.shutdown()
+
+    run(scenario(), timeout=150.0)

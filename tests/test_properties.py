@@ -68,11 +68,36 @@ certificates = st.builds(
 
 _digest_tuple = st.lists(digest, max_size=4).map(tuple)
 
+_r32 = st.binary(min_size=32, max_size=32)
+compact_certificates = st.builds(
+    Certificate,
+    header=headers,
+    signers=st.lists(
+        st.integers(min_value=0, max_value=200), max_size=4, unique=True
+    ).map(lambda xs: tuple(sorted(xs))),
+    signatures=st.lists(_r32, max_size=4).map(tuple),
+    agg_s=_r32,
+)
+
 MESSAGE_STRATEGIES = {
     M.Ack: st.builds(M.Ack),
     M.HeaderMsg: st.builds(M.HeaderMsg, headers),
     M.VoteMsg: st.builds(M.VoteMsg, votes),
-    M.CertificateMsg: st.builds(M.CertificateMsg, certificates),
+    M.CertificateMsg: st.builds(
+        M.CertificateMsg, st.one_of(certificates, compact_certificates)
+    ),
+    M.CertificateRefMsg: st.builds(
+        M.CertificateRefMsg,
+        header_digest=digest,
+        round=rnd,
+        epoch=st.integers(min_value=0, max_value=2**31),
+        origin=pubkey,
+        signers=st.lists(
+            st.integers(min_value=0, max_value=200), max_size=4, unique=True
+        ).map(lambda xs: tuple(sorted(xs))),
+        rs=st.lists(_r32, max_size=4).map(tuple),
+        agg_s=_r32,
+    ),
     M.CertificatesRequest: st.builds(M.CertificatesRequest, _digest_tuple, pubkey),
     M.CertificatesBatchRequest: st.builds(
         M.CertificatesBatchRequest, _digest_tuple, pubkey

@@ -338,3 +338,51 @@ def test_verifier_python_fallback_matches_native(monkeypatch):
     assert with_native == without
     assert not with_native[4] and not with_native[8]
     assert sum(with_native) == 18
+
+
+def test_group_lane_aggregate_verify(run):
+    """The device aggregate lane for compact certificates: submit_groups
+    fuses several half-aggregated proofs into one msm dispatch (doubled
+    rows, per-group random outer weights); honest groups pass, a tampered
+    group is isolated by the host fallback without affecting the others."""
+    import asyncio
+
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.types import Certificate, Vote
+    from narwhal_tpu.tpu.verifier import TpuVerifier, VerifyService
+
+    fx = CommitteeFixture(size=4)
+
+    def make_group(round_, tamper=False):
+        h = fx.header(author=0, round=round_)
+        signers, sigs = [], []
+        for a in fx.authorities:
+            v = Vote.for_header(h, a.public, a.keypair)
+            signers.append(fx.committee.index_of(a.public))
+            sigs.append(v.signature)
+        cc = Certificate.compact_from_votes(h, tuple(signers), tuple(sigs))
+        if tamper:
+            cc = Certificate(
+                cc.header, cc.signers, cc.signatures,
+                bytes([cc.agg_s[0] ^ 1]) + cc.agg_s[1:],
+            )
+        return cc.aggregate_group(fx.committee)
+
+    groups = [make_group(1), make_group(2), make_group(3, tamper=True)]
+
+    v = TpuVerifier(max_bucket=64, msm_min_bucket=16, mode="msm")
+    # Direct kernel path.
+    verdicts = v.collect_groups(v.submit_groups(groups))
+    assert verdicts == [True, True, False]
+
+    # Through the service's group lane (merged dispatch).
+    svc = VerifyService(v, max_batch=64, max_delay=0.002)
+    try:
+        async def scenario():
+            return await asyncio.gather(
+                *(svc.verify_aggregate(*g) for g in groups)
+            )
+
+        assert run(scenario(), timeout=120.0) == [True, True, False]
+    finally:
+        svc.shutdown()

@@ -173,3 +173,85 @@ def test_dag_generators():
     # each non-first round certificate links to all 4 previous certs
     for c in certs:
         assert len(c.header.parents) == 4
+
+
+def test_compact_certificate_roundtrip_and_verify():
+    """Half-aggregated certificates: same digest as the full form, wire
+    round-trip, host verification accepts honest proofs and rejects
+    tampered scalars/swapped nonces (types.py Certificate compact form)."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.types import Certificate, Vote
+
+    fx = CommitteeFixture(size=4)
+    h = fx.header(author=0, round=1)
+    signers, sigs = [], []
+    for a in fx.authorities:
+        v = Vote.for_header(h, a.public, a.keypair)
+        signers.append(fx.committee.index_of(a.public))
+        sigs.append(v.signature)
+    cc = Certificate.compact_from_votes(h, tuple(signers), tuple(sigs))
+    assert cc.is_compact
+    assert cc.digest == fx.certificate(h).digest  # form-independent identity
+    cc.verify(fx.committee, fx.worker_cache)
+    assert Certificate.from_bytes(cc.to_bytes()) == cc
+
+    import pytest as _pytest
+
+    from narwhal_tpu.types import InvalidSignatureError
+
+    bad_s = Certificate(
+        cc.header, cc.signers, cc.signatures,
+        bytes([cc.agg_s[0] ^ 1]) + cc.agg_s[1:],
+    )
+    with _pytest.raises(InvalidSignatureError):
+        bad_s.verify(fx.committee, fx.worker_cache)
+    swapped = list(cc.signatures)
+    swapped[0], swapped[1] = swapped[1], swapped[0]
+    bad_r = Certificate(cc.header, cc.signers, tuple(swapped), cc.agg_s)
+    with _pytest.raises(InvalidSignatureError):
+        bad_r.verify(fx.committee, fx.worker_cache)
+
+
+def test_compact_certificate_broadcast_bytes_at_n50():
+    """The control-plane win at the north-star committee size: a compact
+    certificate announcement (CertificateRefMsg — header by digest +
+    half-aggregated proof) must be >=3x smaller on the wire than today's
+    full-multisig CertificateMsg (VERDICT r3 item 6; the capability the
+    reference's O(1) BLS certificates provide,
+    /root/reference/types/src/primary.rs:386-644)."""
+    import os
+
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.messages import (
+        CertificateMsg,
+        CertificateRefMsg,
+        encode_message,
+    )
+    from narwhal_tpu.types import Certificate, Header, Vote
+
+    fx = CommitteeFixture(size=50)
+    committee = fx.committee
+    # A realistic round-r header: 50 parent digests + some payload.
+    parents = {os.urandom(32) for _ in range(50)}
+    payload = {os.urandom(32): 0 for _ in range(8)}
+    a0 = fx.authorities[0]
+    h = Header.build(a0.public, 5, 0, payload, parents, a0.keypair)
+    # Quorum of signers (2f+1 = 34 of 50).
+    quorum = fx.authorities[:34]
+    signers = tuple(sorted(committee.index_of(a.public) for a in quorum))
+    by_index = {committee.index_of(a.public): a for a in quorum}
+    sigs = tuple(
+        Vote.for_header(h, by_index[i].public, by_index[i].keypair).signature
+        for i in signers
+    )
+    full = Certificate(h, signers, sigs)
+    compact = Certificate.compact_from_votes(h, signers, sigs)
+
+    _, full_bytes = encode_message(CertificateMsg(full))
+    _, ref_bytes = encode_message(
+        CertificateRefMsg.from_certificate(compact)
+    )
+    ratio = len(full_bytes) / len(ref_bytes)
+    assert ratio >= 3.0, (len(full_bytes), len(ref_bytes), ratio)
+    # And the compact proof still verifies.
+    compact.verify(committee, fx.worker_cache)
