@@ -25,13 +25,13 @@ ROUNDS = 3
 
 def main() -> None:
     # Persist compiled kernels across runs (first compile is minutes; the
-    # cache makes every later bench/boot start in seconds).
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache")
-    )
-    import jax
+    # cache makes every later bench/boot start in seconds). Routed through
+    # enable_compilation_cache for the per-platform subdirectory.
+    import jax  # noqa: F401
 
-    jax.config.update("jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+    from narwhal_tpu.tpu import enable_compilation_cache
+
+    enable_compilation_cache()
 
     from narwhal_tpu.crypto import KeyPair, _host_batch_verify
     from narwhal_tpu.tpu.verifier import TpuVerifier

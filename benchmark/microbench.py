@@ -85,6 +85,64 @@ def bench_process_certificates(size: int = 20, rounds: int = 50) -> list[dict]:
     return out
 
 
+def bench_dag_service(sizes=(20, 50, 100), rounds: int = 24) -> list[dict]:
+    """External Dag service read_causal: host BFS vs the device reach_mask
+    backend, across committee sizes (VERDICT r3 item 8 — the device path
+    is this framework's analog of the reference's rayon-parallel path
+    compression, dag/src/lib.rs:231-276; a 1-core host has no thread
+    parallelism to offer, the device does)."""
+    import asyncio
+
+    from narwhal_tpu.consensus.dag import Dag
+    from narwhal_tpu.fixtures import CommitteeFixture, mock_certificate
+    from narwhal_tpu.types import Certificate
+
+    out = []
+    for size in sizes:
+        f = CommitteeFixture(size=size)
+        keys = f.committee.authority_keys()
+        prev = [c.digest for c in Certificate.genesis(f.committee)]
+        certs = []
+        # Payload-bearing certificates: empty-payload vertices are
+        # compressible and the host walk would collapse to O(1) — the real
+        # serving workload reports full causal histories.
+        for r in range(1, rounds + 1):
+            cur = []
+            for i, pk in enumerate(keys):
+                c = mock_certificate(
+                    f.committee, pk, r, set(prev),
+                    payload={bytes([r % 256, i % 256]) * 16: 0},
+                )
+                cur.append(c)
+            certs.extend(cur)
+            prev = [c.digest for c in cur]
+
+        async def run_one(backend: str) -> float:
+            dag = Dag(f.committee, backend=backend, window=rounds + 8)
+            for c in certs:
+                await dag.insert(c)
+            tip = certs[-1].digest
+            await dag.read_causal(tip)  # warm (compile on the tpu backend)
+            n, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < 1.0:
+                await dag.read_causal(tip)
+                n += 1
+            return (time.perf_counter() - t0) / n
+
+        for backend in ("cpu", "tpu"):
+            dt = asyncio.run(run_one(backend))
+            out.append(
+                {
+                    "metric": f"dag_service_read_causal_ms[{backend}]",
+                    "value": round(dt * 1000, 3),
+                    "unit": "ms/call",
+                    "committee": size,
+                    "rounds": rounds,
+                }
+            )
+    return out
+
+
 def bench_codec() -> list[dict]:
     """Message encode/decode throughput on a payload-bearing header."""
     from narwhal_tpu.fixtures import CommitteeFixture
@@ -125,9 +183,14 @@ def bench_codec() -> list[dict]:
 def main() -> None:
     ap = argparse.ArgumentParser(prog="benchmark.microbench")
     ap.add_argument("--profile", action="store_true", help="cProfile the consensus bench")
+    ap.add_argument("--dag-service", action="store_true",
+                    help="also run the Dag-service read_causal cpu-vs-tpu bench")
     args = ap.parse_args()
     for rec in bench_batch_digest() + bench_codec() + bench_process_certificates():
         print(json.dumps(rec))
+    if args.dag_service:
+        for rec in bench_dag_service():
+            print(json.dumps(rec))
     if args.profile:
         prof = cProfile.Profile()
         prof.enable()

@@ -70,13 +70,39 @@ class Dag:
     without the feed running.
     """
 
-    def __init__(self, committee: Committee, rx_primary: Channel | None = None):
+    def __init__(
+        self,
+        committee: Committee,
+        rx_primary: Channel | None = None,
+        backend: str = "cpu",  # cpu | tpu: device-resident causal reads
+        window: int = 64,
+    ):
         self.rx_primary = rx_primary
         self._dag: NodeDag = NodeDag()
         self._vertices: dict[tuple[PublicKey, Round], Digest] = {}
+        # Live-vertex count per round, maintained incrementally so the
+        # device backend's window-floor decisions are O(1) per operation
+        # instead of rescanning every live vertex (the paths are sold as
+        # flat in committee size).
+        self._round_live: dict[Round, int] = defaultdict(int)
+        self._min_live: Round = 0
         self._lock = asyncio.Lock()
         self._obligations: dict[Digest, list[asyncio.Future]] = defaultdict(list)
         self._task: asyncio.Task | None = None
+        # Device window (backend="tpu"): the dense [W, N, N] adjacency of
+        # the live rounds, so ReadCausal/NodeReadCausal run as ONE
+        # reach_mask dispatch — flat in committee size — instead of a host
+        # BFS (the rayon-parallel walk of /root/reference/dag/src/
+        # lib.rs:231-276, re-expressed as a device scan; a 1-core host has
+        # no thread parallelism to offer, the device does).
+        self._win = None
+        self._reach = None
+        if backend == "tpu":
+            from ..tpu.dag_kernels import DagWindow, reach_mask
+            import jax
+
+            self._win = DagWindow(committee, window)
+            self._reach = jax.jit(reach_mask)
         for cert in Certificate.genesis(committee):
             self._insert(cert)
 
@@ -99,12 +125,82 @@ class Dag:
 
     # -- internals (lock held by callers of the async wrappers) -----------
 
+    def _vertices_changed(self, added: Round | None = None) -> None:
+        """Maintain the per-round live counts after a single insert
+        (`added`) or a bulk rebuild of `_vertices` (added=None)."""
+        if added is not None:
+            if self._round_live[added] == 0 and added < self._min_live:
+                self._min_live = added
+            self._round_live[added] += 1
+            return
+        self._round_live = defaultdict(int)
+        for (_, r) in self._vertices:
+            self._round_live[r] += 1
+        self._min_live = min(self._round_live, default=0)
+
+    def _floor(self) -> Round:
+        """Lowest round with a live vertex, O(1) amortized."""
+        while self._round_live and self._round_live.get(self._min_live, 0) == 0:
+            self._round_live.pop(self._min_live, None)
+            self._min_live += 1
+        return self._min_live if self._round_live else 0
+
     def _insert(self, certificate: Certificate) -> None:
         self._dag.try_insert(_CertVertex(certificate))
-        self._vertices[(certificate.origin, certificate.round)] = certificate.digest
+        key = (certificate.origin, certificate.round)
+        if key not in self._vertices:
+            self._vertices_changed(added=certificate.round)
+        self._vertices[key] = certificate.digest
+        if self._win is not None:
+            # keep_floor = lowest live round: the window may slide past
+            # anything below it (those vertices are gone from _vertices),
+            # preserving the invariant that every live round is in-window.
+            self._win.insert(certificate, self._floor())
         for fut in self._obligations.pop(certificate.digest, []):
             if not fut.done():
                 fut.set_result(certificate)
+
+    def _device_causal(self, start: Digest) -> list[Digest] | None:
+        """ReadCausal as one reach_mask dispatch over the device window;
+        None -> caller falls back to the host BFS (start outside the
+        window, or live history extends below the window base)."""
+        import numpy as np
+
+        win = self._win
+        pos = win.digest_pos.get(start)
+        if pos is None:
+            return None
+        if self._floor() < win.round_base:
+            return None  # incomplete coverage; host walk is authoritative
+        round_, idx = pos
+        onehot = np.zeros((win.N,), np.uint8)
+        onehot[idx] = 1
+        mask = np.asarray(
+            self._reach(
+                win.parent,
+                win.present,
+                np.int32(round_ - win.round_base),
+                onehot,
+            )
+        )
+        out: list[Digest] = []
+        ws, ns = np.nonzero(mask)
+        # Start-first, ancestors after (descending round), the shape of the
+        # host BFS; within a round the order is ascending authority index.
+        for w, n in sorted(zip(ws.tolist(), ns.tolist()), key=lambda t: (-t[0], t[1])):
+            cert = win.cert_at(win.round_base + int(w), int(n))
+            if cert is None:
+                continue
+            node = self._dag._nodes.get(cert.digest)
+            if node is None or not node.live:
+                continue
+            # The BFS reports the start plus its INCOMPRESSIBLE ancestors;
+            # the raw-edge mask also hits compressed interior vertices —
+            # filter them (reachability through them is identical).
+            if cert.digest != start and node.compressible:
+                continue
+            out.append(cert.digest)
+        return out
 
     # -- commands (consensus/src/dag.rs:370-516) ---------------------------
 
@@ -132,6 +228,7 @@ class Dag:
                     for k, d in self._vertices.items()
                     if self._dag.contains_live(d)
                 }
+                self._vertices_changed()
             alive = sorted(
                 r
                 for (pk, r), digest in self._vertices.items()
@@ -142,23 +239,33 @@ class Dag:
             return alive[0], alive[-1]
 
     async def read_causal(self, start: Digest) -> list[Digest]:
-        """BFS of the causal history of `start` over live vertices; bypassed
-        (compressible) vertices are never reported."""
+        """Causal history of `start` over live vertices; bypassed
+        (compressible) vertices are never reported. With the tpu backend
+        the traversal is one device reach_mask dispatch when the window
+        covers the live history (host BFS fallback otherwise)."""
         async with self._lock:
+            return self._read_causal_locked(start)
+
+    def _read_causal_locked(self, start: Digest) -> list[Digest]:
+        if self._win is not None:
             try:
-                return [v.cert.digest for v in self._dag.bft(start)]
+                self._dag.get(start)  # same unknown/dropped semantics as bft
             except (UnknownDigests, DroppedDigest) as e:
                 raise ValidatorDagError(str(e)) from e
+            dev = self._device_causal(start)
+            if dev is not None:
+                return dev
+        try:
+            return [v.cert.digest for v in self._dag.bft(start)]
+        except (UnknownDigests, DroppedDigest) as e:
+            raise ValidatorDagError(str(e)) from e
 
     async def node_read_causal(self, origin: PublicKey, round: Round) -> list[Digest]:
         async with self._lock:
             digest = self._vertices.get((origin, round))
             if digest is None:
                 raise NoCertificateForCoordinates(origin, round)
-            try:
-                return [v.cert.digest for v in self._dag.bft(digest)]
-            except (UnknownDigests, DroppedDigest) as e:
-                raise ValidatorDagError(str(e)) from e
+            return self._read_causal_locked(digest)
 
     async def remove(self, digests: list[Digest]) -> None:
         """Mark certificates for compression and drop them from the
@@ -178,6 +285,7 @@ class Dag:
             self._vertices = {
                 k: v for k, v in self._vertices.items() if v not in todrop
             }
+            self._vertices_changed()
             # A digest actually removed will never be inserted again: fail its
             # waiters now rather than leaving futures pending forever. Unknown
             # digests are NOT failed — they were not removed and may still be

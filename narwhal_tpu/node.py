@@ -267,7 +267,11 @@ class PrimaryNode:
         else:
             # External consensus: the Dag service consumes the certificate
             # stream and serves causal queries (node/src/lib.rs:198-213).
-            self.dag = Dag(committee, self.tx_new_certificates)
+            # With --dag-backend tpu, ReadCausal/NodeReadCausal run as one
+            # device reach_mask dispatch over the dense window.
+            self.dag = Dag(
+                committee, self.tx_new_certificates, backend=dag_backend
+            )
 
         # Block services + the public consensus API (primary/src/grpc_server).
         self.block_synchronizer = BlockSynchronizer(

@@ -31,6 +31,16 @@ def enable_compilation_cache() -> None:
     try:
         import jax
 
+        # Per-platform subdirectory: AOT executables are machine-feature
+        # specific, and a cache mixing entries from different backends /
+        # feature sets can SIGILL on load (observed with cpu entries under
+        # the axon plugin's environment).
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+        cache_dir = os.path.join(cache_dir, platform)
+        os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -114,6 +114,8 @@ class TpuVerifier:
         mode: str | None = None,
         msm_min_bucket: int = 512,
         fixed_bucket: bool = False,
+        mesh=None,
+        data_axis: str = "data",
     ):
         import os
 
@@ -133,6 +135,38 @@ class TpuVerifier:
         # (a 16-item and a 4096-item dispatch both take ~100 ms through
         # the tunnel). The protocol-serving VerifyService runs this way.
         self.fixed_bucket = fixed_bucket
+        # mesh: shard verify batches over the mesh's data axis (SURVEY
+        # §7.8a's TpuVerifier service at §5.8 scale — the certificate
+        # analog of `--dag-shards` for the commit walk). Items are
+        # embarrassingly parallel; the per-item kernel shards its whole
+        # batch, the msm kernel's shared accumulator V comes back via the
+        # XLA-inserted cross-device reduction. Constraint: every bucket
+        # size (powers of two up to max_bucket) must be divisible by the
+        # data-axis size.
+        self.mesh = mesh
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            def s(*spec):
+                return NamedSharding(mesh, P(*spec))
+
+            b1, b2 = s(data_axis), s(data_axis, None)
+            self._item_kernel = jax.jit(
+                kernel.verify_batch_kernel.__wrapped__,
+                in_shardings=(b2, b1, b2, b1, b2, b2),
+                out_shardings=(b1, b1),
+            )
+            self._msm_kernel = jax.jit(
+                kernel.msm_accumulate_kernel.__wrapped__,
+                static_argnames=("chunk",),
+                in_shardings=(b2, b1, b2, b1, b2, b2),
+                out_shardings=(s(), b1),  # V replicated (reduced), valid sharded
+            )
+        else:
+            self._item_kernel = kernel.verify_batch_kernel
+            self._msm_kernel = kernel.msm_accumulate_kernel
 
     def precompile(self, sizes: Sequence[int] = ()) -> None:
         """Warm the jit trace+compile caches for the given bucket sizes —
@@ -316,7 +350,7 @@ class TpuVerifier:
 
         k_digits = self.kernel.bytes_to_digits(pad_to(k_raw)).astype(np.int8)
         s_digits = self.kernel.bytes_to_digits(pad_to(s_raw)).astype(np.int8)
-        return self.kernel.verify_batch_kernel(
+        return self._item_kernel(
             pad_to(a_y), pad_to(a_sign), pad_to(r_y), pad_to(r_sign),
             k_digits, s_digits,
         )
@@ -387,7 +421,7 @@ class TpuVerifier:
                 [arr[lo:hi], np.zeros((pad,) + arr.shape[1:], arr.dtype)]
             )
 
-        out = self.kernel.msm_accumulate_kernel(
+        out = self._msm_kernel(
             zpad(a_y), zpad(a_sign), zpad(r_y), zpad(r_sign),
             ak_digits, z_digits,
         )
@@ -507,7 +541,7 @@ class TpuVerifier:
         zero_sign = np.zeros_like(a_sign)
         ak_digits = self.kernel.bytes_to_digits(ak_rows).astype(np.int8)
         z_digits = np.zeros((bucket, 32), np.int8)
-        out = self.kernel.msm_accumulate_kernel(
+        out = self._msm_kernel(
             a_y, a_sign, zero_y, zero_sign, ak_digits, z_digits
         )
         for arr in out:
@@ -745,6 +779,10 @@ class VerifyService:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._wake:
+            if self._closed:
+                # The submit thread is gone (or draining): an enqueued
+                # future would never resolve.
+                raise RuntimeError("verify service shut down")
             self._pending.append(
                 ((public_key, message, signature), loop, fut, time.monotonic())
             )
@@ -758,6 +796,8 @@ class VerifyService:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._wake:
+            if self._closed:
+                raise RuntimeError("verify service shut down")
             self._pending_groups.append(
                 ((items, zs, s_agg), loop, fut, time.monotonic())
             )

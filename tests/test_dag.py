@@ -282,3 +282,57 @@ class TestDagService:
             await dag.shutdown()
 
         run(scenario())
+
+
+class TestDeviceDagService:
+    def test_device_read_causal_matches_host(self, run):
+        """backend="tpu": ReadCausal/NodeReadCausal served by one
+        reach_mask dispatch must return exactly the host BFS's vertex set
+        across random DAGs with mixed payloads (compressible interiors),
+        removals, and window coverage fallbacks."""
+        import random
+
+        from narwhal_tpu.fixtures import CommitteeFixture, mock_certificate
+
+        rng = random.Random(7)
+
+        async def scenario():
+            for trial in range(4):
+                f = CommitteeFixture(size=4)
+                genesis = [c.digest for c in Certificate.genesis(f.committee)]
+                keys = f.committee.authority_keys()
+                host = Dag(f.committee)
+                dev = Dag(f.committee, backend="tpu", window=16)
+                prev = list(genesis)
+                all_certs = []
+                for r in range(1, 7):
+                    cur = []
+                    for i, pk in enumerate(keys):
+                        payload = (
+                            {bytes([r, i]) * 16: 0} if rng.random() < 0.5 else {}
+                        )
+                        c = mock_certificate(
+                            f.committee, pk, r,
+                            set(rng.sample(prev, k=max(3, len(prev) - 1))),
+                            payload=payload,
+                        )
+                        cur.append(c)
+                        all_certs.append(c)
+                    prev = [c.digest for c in cur]
+                for c in all_certs:
+                    await host.insert(c)
+                    await dev.insert(c)
+                # Remove a random earlier certificate on both.
+                victim = all_certs[rng.randrange(len(all_certs) // 2)]
+                await host.remove([victim.digest])
+                await dev.remove([victim.digest])
+                for c in all_certs[-8:]:
+                    h = await host.read_causal(c.digest)
+                    d = await dev.read_causal(c.digest)
+                    assert set(h) == set(d), (trial, c.round)
+                    assert d[0] == c.digest  # start-first shape
+                    n_h = await host.node_read_causal(c.origin, c.round)
+                    n_d = await dev.node_read_causal(c.origin, c.round)
+                    assert set(n_h) == set(n_d)
+
+        run(scenario(), timeout=120.0)

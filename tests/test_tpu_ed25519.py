@@ -238,7 +238,11 @@ def test_msm_torsion_defect_is_deterministic(msm_verifier):
         r_scalar = int.from_bytes(os.urandom(32), "little") % ref.L
         r_bytes = ref.compress(ref.point_mul(r_scalar, ref.G))
         k = ref.sha512_mod_l(r_bytes, pk_t, msg)
-        if k % 8 != 0:  # ensure the torsion residual is non-zero
+        # k odd => gcd(k, 8) = 1 => [k]T is non-identity for ANY
+        # non-identity 8-torsion T (k % 8 != 0 alone is NOT enough: T may
+        # have order 2 or 4, and an even k annihilates it — the rare flake
+        # this loop previously had).
+        if k % 2 == 1:
             break
     s = (r_scalar + k * a_scalar) % ref.L
     sig = r_bytes + s.to_bytes(32, "little")
