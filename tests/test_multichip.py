@@ -43,3 +43,49 @@ def test_dryrun_multichip_odd_mesh():
     if len(cpus) < 3:
         pytest.skip("need 3 cpu devices")
     __graft_entry__.dryrun_multichip(3, devices=cpus)
+
+
+def test_dryrun_pins_unsharded_dispatch(monkeypatch):
+    """MULTICHIP_r04 regression: the unsharded comparison TpuVerifier's
+    module-level jitted kernels dispatched to the *default backend* (the
+    real chip on the bench host — version-skewed that day), so the CPU-mesh
+    correctness artifact went red for a reason unrelated to sharding.
+
+    Reproduce the failure mode on the virtual mesh: pin the dry run to the
+    UPPER half of the 8 CPU devices, spy on every ed25519 kernel dispatch,
+    and assert no kernel output ever lands on a device outside the pinned
+    list. Without `jax.default_device(devs[0])` around the dryrun body the
+    unsharded verifier's outputs land on the process default device
+    (cpus[0]) and this test fails — exactly the class of bug the r02/r04
+    artifacts died on, which `devices=cpus` tests structurally cannot see."""
+    import narwhal_tpu.tpu.ed25519 as ed
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        pytest.skip("need 8 cpu devices")
+    allowed = set(cpus[4:8])
+    placements = []
+
+    def spying(kernel):
+        def spy(*args, **kwargs):
+            out = kernel(*args, **kwargs)
+            for leaf in jax.tree_util.tree_leaves(out):
+                placements.extend(leaf.devices())
+            return out
+
+        # The mesh-sharded verifier re-jits kernel.__wrapped__ with explicit
+        # in_shardings; keep that route intact (it is pinned by
+        # construction — the spy watches the *unsharded* dispatch path).
+        spy.__wrapped__ = kernel.__wrapped__
+        return spy
+
+    monkeypatch.setattr(ed, "verify_batch_kernel", spying(ed.verify_batch_kernel))
+    monkeypatch.setattr(
+        ed, "msm_accumulate_kernel", spying(ed.msm_accumulate_kernel)
+    )
+    __graft_entry__.dryrun_multichip(4, devices=cpus[4:])
+    assert placements, "the dry run's verifier leg never dispatched a kernel"
+    outside = {str(d) for d in placements if d not in allowed}
+    assert not outside, (
+        f"kernel dispatch landed outside the pinned device list: {outside}"
+    )
