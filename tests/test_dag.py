@@ -286,10 +286,12 @@ class TestDagService:
 
 class TestDeviceDagService:
     def test_device_read_causal_matches_host(self, run):
-        """backend="tpu": ReadCausal/NodeReadCausal served by one
-        reach_mask dispatch must return exactly the host BFS's vertex set
-        across random DAGs with mixed payloads (compressible interiors),
-        removals, and window coverage fallbacks."""
+        """backend="tpu", policy="device": ReadCausal/NodeReadCausal served
+        by one reach_mask dispatch must return exactly the host BFS's
+        result — same vertices, same canonical order (advisor r4: the
+        external API's order must be backend-invariant) — across random
+        DAGs with mixed payloads (compressible interiors), removals, and
+        window coverage fallbacks."""
         import random
 
         from narwhal_tpu.fixtures import CommitteeFixture, mock_certificate
@@ -302,7 +304,7 @@ class TestDeviceDagService:
                 genesis = [c.digest for c in Certificate.genesis(f.committee)]
                 keys = f.committee.authority_keys()
                 host = Dag(f.committee)
-                dev = Dag(f.committee, backend="tpu", window=16)
+                dev = Dag(f.committee, backend="tpu", window=16, policy="device")
                 prev = list(genesis)
                 all_certs = []
                 for r in range(1, 7):
@@ -329,10 +331,112 @@ class TestDeviceDagService:
                 for c in all_certs[-8:]:
                     h = await host.read_causal(c.digest)
                     d = await dev.read_causal(c.digest)
-                    assert set(h) == set(d), (trial, c.round)
+                    assert h == d, (trial, c.round)  # exact canonical order
                     assert d[0] == c.digest  # start-first shape
                     n_h = await host.node_read_causal(c.origin, c.round)
                     n_d = await dev.node_read_causal(c.origin, c.round)
-                    assert set(n_h) == set(n_d)
+                    assert n_h == n_d
+                assert dev.routing_stats()["dev_calls"] > 0
+
+        run(scenario(), timeout=120.0)
+
+    def test_concurrent_reads_coalesce_into_one_dispatch(self, run):
+        """K concurrent ReadCausal requests on the device path must fuse
+        into ONE vmapped reach_mask dispatch (the RTT-amortization the
+        routing policy's device side is priced on)."""
+        from narwhal_tpu.fixtures import CommitteeFixture, mock_certificate
+
+        async def scenario():
+            f = CommitteeFixture(size=4)
+            genesis = [c.digest for c in Certificate.genesis(f.committee)]
+            keys = f.committee.authority_keys()
+            dev = Dag(f.committee, backend="tpu", window=16, policy="device")
+            host = Dag(f.committee)
+            prev = list(genesis)
+            tips = []
+            for r in range(1, 5):
+                cur = [
+                    mock_certificate(
+                        f.committee, pk, r, set(prev),
+                        payload={bytes([r, i]) * 16: 0},
+                    )
+                    for i, pk in enumerate(keys)
+                ]
+                for c in cur:
+                    await dev.insert(c)
+                    await host.insert(c)
+                prev = [c.digest for c in cur]
+                tips = cur
+            dispatches = 0
+            real_many = dev._device_causal_many
+
+            def counting(starts):
+                nonlocal dispatches
+                dispatches += 1
+                return real_many(starts)
+
+            dev._device_causal_many = counting
+            results = await asyncio.gather(
+                *(dev.read_causal(c.digest) for c in tips)
+            )
+            assert dispatches == 1, "concurrent reads must share one dispatch"
+            for c, got in zip(tips, results):
+                assert got == await host.read_causal(c.digest)
+
+        run(scenario(), timeout=120.0)
+
+    def test_shutdown_fails_stranded_device_readers(self, run):
+        """Shutdown with queued (unflushed) device requests must fail
+        their futures — a reader awaiting a coalesced dispatch cannot be
+        left hanging forever when the flush task is cancelled."""
+        from narwhal_tpu.fixtures import CommitteeFixture
+
+        async def scenario():
+            f = CommitteeFixture(size=4)
+            dag = Dag(f.committee, backend="tpu", window=16, policy="device")
+            fut = asyncio.get_running_loop().create_future()
+            dag._dev_queue.append((b"\x00" * 32, fut))
+            await dag.shutdown()
+            with pytest.raises(ValidatorDagError, match="shut down"):
+                await fut
+
+        run(scenario(), timeout=30.0)
+
+    def test_adaptive_policy_routes_to_measured_faster_path(self, run):
+        """policy="adaptive" (the default): after both paths have been
+        measured, requests go to the faster one — on the virtual-CPU test
+        host the BFS wins, so a long request stream must be served
+        overwhelmingly by the host path (the measured-crossover fence for
+        the r4 'device path 3-30x slower yet preferred' regression)."""
+        from narwhal_tpu.fixtures import CommitteeFixture, mock_certificate
+
+        async def scenario():
+            f = CommitteeFixture(size=4)
+            genesis = [c.digest for c in Certificate.genesis(f.committee)]
+            keys = f.committee.authority_keys()
+            dag = Dag(f.committee, backend="tpu", window=16)
+            prev = list(genesis)
+            tip = None
+            for r in range(1, 5):
+                cur = [
+                    mock_certificate(
+                        f.committee, pk, r, set(prev),
+                        payload={bytes([r, i]) * 16: 0},
+                    )
+                    for i, pk in enumerate(keys)
+                ]
+                for c in cur:
+                    await dag.insert(c)
+                prev = [c.digest for c in cur]
+                tip = cur[0]
+            # Fake the device measurement as catastrophically slow (the
+            # tunneled-chip regime) so the adaptive router must fence it.
+            dag._ewma["dev"] = 1.0
+            dag._dev_warmed.add(1)
+            for _ in range(20):
+                await dag.read_causal(tip.digest)
+            stats = dag.routing_stats()
+            assert stats["host_calls"] >= 19  # probes aside, host serves
+            assert stats["ewma_host_ms"] is not None
 
         run(scenario(), timeout=120.0)
