@@ -105,6 +105,7 @@ async def _run_node(args) -> None:
             crypto_backend=getattr(args, "crypto_backend", "cpu"),
             dag_backend=getattr(args, "dag_backend", "cpu"),
             dag_shards=getattr(args, "dag_shards", 1),
+            verify_shards=getattr(args, "verify_shards", 1),
             network_keypair=network_keypair,
         )
         await node.spawn()
@@ -198,6 +199,12 @@ def main(argv: list[str] | None = None) -> None:
         "--dag-shards", type=int, default=1,
         help="with --dag-backend tpu: shard the committee axis of the DAG "
         "window over this many devices (an 'auth' mesh; 1 = single device)",
+    )
+    p.add_argument(
+        "--verify-shards", type=int, default=1,
+        help="with --crypto-backend tpu: shard every verify flush over this "
+        "many devices (a 'data' mesh; must divide the service's dispatch "
+        "bucket — validated at startup)",
     )
     p.add_argument(
         "--consensus-protocol", choices=("bullshark", "tusk"), default="bullshark",

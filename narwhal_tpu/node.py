@@ -81,6 +81,7 @@ class PrimaryNode:
         crypto_backend: str = "cpu",  # cpu | pool | tpu
         dag_backend: str = "cpu",  # cpu | tpu
         dag_shards: int = 1,  # devices on the mesh's 'auth' axis (tpu backend)
+        verify_shards: int = 1,  # devices on the verifier's 'data' axis (tpu)
         network_keypair: KeyPair | None = None,
     ):
         self.keypair = keypair
@@ -114,12 +115,25 @@ class PrimaryNode:
         rule = getattr(parameters, "verify_rule", "strict")
         if rule not in ("strict", "cofactored"):
             raise ValueError(f"parameters.verify_rule must be strict|cofactored, got {rule!r}")
+        # cert_format is committee-wide wire format: a typo silently
+        # behaving as 'full' in a 'compact' committee would mix certificate
+        # wire forms instead of failing fast (advisor r4).
+        cert_format = getattr(parameters, "cert_format", "full")
+        if cert_format not in ("full", "compact"):
+            raise ValueError(
+                f"parameters.cert_format must be full|compact, got {cert_format!r}"
+            )
         if rule == "cofactored" and crypto_backend != "tpu":
             raise ValueError(
                 "parameters.verify_rule=cofactored: only the tpu crypto "
                 f"backend implements the cofactored accept set (got "
                 f"crypto_backend={crypto_backend!r}). Use --crypto-backend "
                 "tpu on every node, or set verify_rule=strict."
+            )
+        if verify_shards > 1 and crypto_backend != "tpu":
+            raise ValueError(
+                f"--verify-shards {verify_shards} requires --crypto-backend "
+                f"tpu (got {crypto_backend!r})"
             )
         crypto_pool = None
         if crypto_backend == "tpu":
@@ -137,8 +151,14 @@ class PrimaryNode:
                 # ONE pipelined service per process: every node on this
                 # host shares flushes, so the device link RTT is paid per
                 # merged batch, not per protocol hop (the VERDICT r3
-                # crypto=tpu stall at N=20).
-                crypto_pool = VerifyService.shared(mode)
+                # crypto=tpu stall at N=20). --verify-shards N spreads
+                # every flush over an N-device 'data' mesh
+                # (verifier.data_mesh); bucket divisibility is validated
+                # inside the TpuVerifier constructor, so a mis-sized mesh
+                # fails the boot, not the first dispatch.
+                crypto_pool = VerifyService.shared(mode, shards=verify_shards)
+            except ValueError:
+                raise  # mis-sized shard count: a config error, never fallback
             except Exception:
                 # Under the cofactored rule the device path is mandatory: a
                 # host fallback would run the STRICT accept set — a
@@ -283,6 +303,10 @@ class PrimaryNode:
             self.primary.network,
             parameters,
             tx_loopback=self.primary.tx_primary_messages,
+            # Catch-up verification rides the same batched lane as live
+            # traffic (advisor r4: compact-cert catch-up must not fall back
+            # to pure-Python aggregate verification on tpu-backend nodes).
+            crypto_pool=crypto_pool,
         )
         self.block_waiter = BlockWaiter(
             self.name,

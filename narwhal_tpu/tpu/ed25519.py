@@ -81,13 +81,14 @@ _ONE = _col(1)
 _SUB_BIAS = np.array([15168] + [16382] * (NLIMB - 1), np.int32)[:, None]
 assert limbs_to_int(_SUB_BIAS) == 64 * ref.P
 
-# Fixed-base window table: 16 small multiples of B in affine (x, y, x*y);
-# identity row is (0, 1, 0) and Z is forced to 1 at selection time.
+# Fixed-base window table: 16 small multiples of B in CACHED affine form
+# (y+x, y−x, 2d·t mod p) — identity row is (1, 1, 0), Z == 1 implicitly, so
+# each table add is the 7-mul pt_add_cached_z1.
 _BT = np.zeros((16, 3, NLIMB), np.int32)
 for _dd, (_x, _y, _t) in enumerate(ref.base_window_table()):
-    _BT[_dd, 0] = int_to_limbs(_x)
-    _BT[_dd, 1] = int_to_limbs(_y)
-    _BT[_dd, 2] = int_to_limbs(_t)
+    _BT[_dd, 0] = int_to_limbs((_y + _x) % ref.P)
+    _BT[_dd, 1] = int_to_limbs((_y - _x) % ref.P)
+    _BT[_dd, 2] = int_to_limbs(2 * ref.D * _t % ref.P)
 
 
 # ---------------------------------------------------------------------------
@@ -301,6 +302,45 @@ def pt_neg(p):
     return (fe_neg(x), y, z, fe_neg(t))
 
 
+# Cached-form addition (the dalek/ref10 "cached point" trick): a table
+# entry stored as (Y+X, Y−X, Z, 2D·T) turns the complete 9-mul pt_add into
+# an 8-mul add — the (t1·2D)·t2 double-multiply collapses into one t1·t2d.
+# Table entries are added ~100x each (once per window lane), so the one
+# extra mul spent caching each entry buys back 64-96 muls per point.
+
+
+def pt_cache(p):
+    """Projective (X, Y, Z, T) -> cached (Y+X, Y−X, Z, 2D·T). All outputs
+    stay inside the loose bound (add <= 9409, sub <= 8801, mul <= 8800)."""
+    x, y, z, t = p
+    return (fe_add(y, x), fe_sub(y, x), z, fe_mul(t, _bcast(_2D, t)))
+
+
+def pt_add_cached(p, q):
+    """p projective + q cached: 8 fe_muls (vs pt_add's 9)."""
+    x1, y1, z1, t1 = p
+    yp2, ym2, z2, t2d = q
+    a = fe_mul(fe_sub(y1, x1), ym2)
+    b = fe_mul(fe_add(y1, x1), yp2)
+    c = fe_mul(t1, t2d)
+    d = fe_mul(fe_add(z1, z1), z2)
+    e, f, g, h = fe_sub(b, a), fe_sub(d, c), fe_add(d, c), fe_add(b, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def pt_add_cached_z1(p, q):
+    """p projective + q cached with Z2 == 1 (affine table constants): the
+    d term needs no multiply — 7 fe_muls."""
+    x1, y1, z1, t1 = p
+    yp2, ym2, t2d = q
+    a = fe_mul(fe_sub(y1, x1), ym2)
+    b = fe_mul(fe_add(y1, x1), yp2)
+    c = fe_mul(t1, t2d)
+    d = fe_add(z1, z1)
+    e, f, g, h = fe_sub(b, a), fe_sub(d, c), fe_add(d, c), fe_add(b, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
 # ---------------------------------------------------------------------------
 # Decompression and batched verification (limb-major, batch in the lanes).
 # ---------------------------------------------------------------------------
@@ -381,35 +421,25 @@ def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
     B = a_y.shape[1]
 
     a_point, valid = decompress(a_y, a_sign)
-    neg_a = pt_neg(a_point)
 
-    # 16 multiples of -A built on device; 16 multiples of B from the host.
-    def next_multiple(prev, _):
-        nxt = pt_add(prev, neg_a)
-        return nxt, nxt
-
-    _, higher = lax.scan(next_multiple, neg_a, None, length=14)  # [14, ...] x4
+    # 16 cached multiples of -A built on device; 16 cached multiples of B
+    # from the host. Every window add is then the 8-mul (device table) or
+    # 7-mul (affine host table) cached form instead of the 9-mul pt_add.
+    table_a = _pt_cached_table(pt_neg(a_point), B)
     ident = pt_identity((B,))
-    table_a = tuple(
-        jnp.concatenate([ident[i][None], neg_a[i][None], higher[i]], axis=0)
-        for i in range(4)
-    )  # 4 coords, each [16, NLIMB, B]
-
-    one = ident[1]
 
     def step(acc, digits):
         kd, sd = digits
         for _ in range(4):
             acc = pt_double(acc)
         qa = tuple(_select(table_a[i], kd) for i in range(4))
-        acc = pt_add(acc, qa)
+        acc = pt_add_cached(acc, qa)
         qb = (
             _select_const(_BT[:, 0], sd),
             _select_const(_BT[:, 1], sd),
-            one,
             _select_const(_BT[:, 2], sd),
         )
-        acc = pt_add(acc, qb)
+        acc = pt_add_cached_z1(acc, qb)
         return acc, None
 
     acc, _ = lax.scan(step, ident, (k_digits, s_digits))
@@ -470,17 +500,23 @@ def _select_lanes(table, digits):
     return cur[0]
 
 
-def _pt_table(neg_p, batch):
-    """16 multiples (identity, P, 2P, ... 15P) of each lane's point:
-    4 coord arrays [16, NLIMB, B] (the per-item kernel's table build)."""
+def _pt_cached_table(neg_p, batch):
+    """16 multiples (identity, P, 2P, ... 15P) of each lane's point in
+    CACHED form (Y+X, Y−X, Z, 2D·T): 4 coord arrays [16, NLIMB, B]. The
+    chain itself runs on the cached base (8-mul adds); each emitted entry
+    pays one extra mul (2D·T) so every later window add saves one."""
+    base_c = pt_cache(neg_p)
+
     def next_multiple(prev, _):
-        nxt = pt_add(prev, neg_p)
-        return nxt, nxt
+        nxt = pt_add_cached(prev, base_c)
+        return nxt, pt_cache(nxt)
 
     _, higher = lax.scan(next_multiple, neg_p, None, length=14)
-    ident = pt_identity((batch,))
+    zero = jnp.zeros((NLIMB, batch), jnp.int32)
+    one = zero.at[0].set(1)
+    ident_c = (one, one, one, zero)  # cached identity: yp=ym=z=1, t2d=0
     return tuple(
-        jnp.concatenate([ident[i][None], neg_p[i][None], higher[i]], axis=0)
+        jnp.concatenate([ident_c[i][None], base_c[i][None], higher[i]], axis=0)
         for i in range(4)
     )
 
@@ -488,10 +524,10 @@ def _pt_table(neg_p, batch):
 def _accumulate_windows(table, digits, chunk):
     """Stream the M points through the window-lane accumulator.
 
-    table: 4 coords [16, NLIMB, M]; digits [M, W]. Returns V: 4 coords
-    [NLIMB, W] = per window lane, Σ_j digit_{j,w}·P_j. Every reduction is
-    a fixed-shape scan so the compiled program stays one body per stage
-    (the unrolled pairwise tree tripled compile time).
+    table: 4 CACHED coords [16, NLIMB, M]; digits [M, W]. Returns V: 4
+    projective coords [NLIMB, W] = per window lane, Σ_j digit_{j,w}·P_j.
+    Every reduction is a fixed-shape scan so the compiled program stays
+    one body per stage (the unrolled pairwise tree tripled compile time).
     """
     M, W = digits.shape
     C = min(chunk, M)
@@ -504,7 +540,7 @@ def _accumulate_windows(table, digits, chunk):
     def step(acc, xs):
         tab, dig = xs
         q = tuple(_select_lanes(tab[i], dig) for i in range(4))
-        return pt_add(acc, q), None
+        return pt_add_cached(acc, q), None
 
     acc0 = pt_identity((W, C))
     acc, _ = lax.scan(step, acc0, (jnp.stack(xs_table, 1), xs_digits))
@@ -528,21 +564,23 @@ def _accumulate_windows(table, digits, chunk):
 @functools.partial(jax.jit, static_argnames=("chunk",))
 def msm_accumulate_kernel(a_y, a_sign, r_y, r_sign, ak_digits, z_digits, chunk=128):
     """Device half of the batch check Σ [z_ik_i](−A_i) + Σ [z_i](−R_i):
-    per-window point sums V_w over the whole batch.
+    per-window point sums over the whole batch.
 
     Host-facing shapes: a_y/r_y int[B, NLIMB] canonical y limbs; signs
     int[B]; ak_digits int[B, 64] = 4-bit MSB-first digits of z_i·k_i mod L;
     z_digits int[B, 32] = digits of the 128-bit z_i. Zero rows are inert
-    padding. Returns (V int32[4, NLIMB, 64] — X/Y/Z/T loose limbs per
-    window lane — and valid bool[B]).
+    padding. Returns (V_a int32[4, NLIMB, 64], V_r int32[4, NLIMB, 32] —
+    X/Y/Z/T loose limbs per window lane — and valid bool[B]).
 
-    The A and R points ride ONE decompress/table/accumulate pipeline
-    (concatenated on the batch axis, z digits zero-extended to 64 windows).
-    The final Horner Σ_w 16^(63-w) V_w is ~300 SEQUENTIAL width-1 point
-    ops — sub-tile work whose per-op overhead costs ~500 ms on this chip,
-    35x the whole wide accumulate — so the host does it instead on the
-    tiny [4, NLIMB, 64] readback with bigint arithmetic in ~2 ms
-    (verifier.msm_epilogue_check), amortized across the batch.
+    The A and R points share one decompress + cached-table build
+    (concatenated batch axis) but run SEPARATE window accumulates: the R
+    scalars are the raw 128-bit z_i, so their accumulator needs only 32
+    window lanes — the r4 kernel zero-extended them to 64 and paid ~32
+    inert 9-mul adds per R point (~16% of the whole kernel's multiplies).
+    The host epilogue Horner-merges both lane sets (the last 32 windows of
+    the chain take V_a[w] + V_r[w-32]) — see verifier.msm_epilogue_check;
+    the ~300 sequential width-1 point ops of that chain would cost ~500 ms
+    as sub-tile device work, vs ~2 ms of host bigint on the tiny readback.
     """
     ak_digits = ak_digits.astype(jnp.int32)
     z_digits = z_digits.astype(jnp.int32)
@@ -550,13 +588,44 @@ def msm_accumulate_kernel(a_y, a_sign, r_y, r_sign, ak_digits, z_digits, chunk=1
 
     ys = jnp.concatenate([a_y.T, r_y.T], axis=1).astype(jnp.int32)  # [NLIMB, 2B]
     signs = jnp.concatenate([a_sign, r_sign]).astype(jnp.int32)
-    z_full = jnp.pad(z_digits, ((0, 0), (WINDOWS - z_digits.shape[1], 0)))
-    digits = jnp.concatenate([ak_digits, z_full], axis=0)  # [2B, 64]
 
     points, valid = decompress(ys, signs)
-    table = _pt_table(pt_neg(points), 2 * B)
-    v = _accumulate_windows(table, digits, chunk)  # 4 coords [NLIMB, 64]
-    return jnp.stack(v, axis=0), valid[:B] & valid[B:]
+    table = _pt_cached_table(pt_neg(points), 2 * B)
+    table_a = tuple(t[..., :B] for t in table)
+    table_r = tuple(t[..., B:] for t in table)
+    v_a = _accumulate_windows(table_a, ak_digits, chunk)  # [NLIMB, 64] x4
+    v_r = _accumulate_windows(table_r, z_digits, chunk)  # [NLIMB, 32] x4
+    return jnp.stack(v_a, axis=0), jnp.stack(v_r, axis=0), valid[:B] & valid[B:]
+
+
+def msm_field_muls_per_signature(batch: int, chunk: int = 128) -> float:
+    """Analytic fe_mul-equivalent cost per signature of the msm path —
+    the roofline denominator for BENCH utilization accounting (VERDICT r4
+    item 2: place the kernel against the measured VPU fe_mul rate).
+
+    An fe_sq counts at its limb-product ratio, 210/400 of an fe_mul (the
+    schoolbook column sums; carries are included in both measured rates).
+    Per SIGNATURE (one A point + one R point):
+
+      decompress x2: the shared exponentiation ladder is 251 sq + ~12 mul
+        (_ladder + pow22523), plus ~4 sq + ~9 mul of surrounding ops;
+      cached table x2: 14 chain adds x 8 mul (pt_add_cached) + 15 cache
+        muls (2D*T per emitted entry incl. the base);
+      accumulate: one 8-mul cached add per window lane — 64 lanes for the
+        A scalar (z*k mod L, 256-bit) + 32 for the R scalar (z, 128-bit);
+      chain reduction: log2(C) pt_adds (9 mul) over (64+32)*C lanes,
+        amortized over the bucket.
+
+    The host Horner epilogue is not counted (it overlaps device compute in
+    the pipelined flow and is measured separately by bench.py)."""
+    sq = 210.0 / 400.0
+    decompress = 2 * ((251 + 4) * sq + 21)
+    table = 2 * (14 * 8 + 15)
+    accumulate = 8 * (64 + 32)
+    c = min(chunk, batch)
+    rounds = (c - 1).bit_length()
+    reduction = 9.0 * rounds * c * (64 + 32) / batch
+    return decompress + table + accumulate + reduction
 
 
 # ---------------------------------------------------------------------------

@@ -53,15 +53,19 @@ def _next_pow2(n: int) -> int:
     return b
 
 
-def msm_epilogue_check(v_limbs: np.ndarray, sum_s: int, kernel) -> bool:
+def msm_epilogue_check(
+    va_limbs: np.ndarray, vr_limbs: np.ndarray, sum_s: int, kernel
+) -> bool:
     """Host half of the batch check: Horner-collapse the device's
-    per-window point sums and test [8]([Σ z_iS_i]B + Σ_w 16^(63-w) V_w)
-    == identity.
+    per-window point sums and test
+    [8]([Σ z_iS_i]B + Σ_w 16^(63-w) (V_a[w] + V_r[w-32])) == identity.
 
-    v_limbs: int32[4, NLIMB, W] loose X/Y/Z/T limbs from
-    msm_accumulate_kernel (MSB-first window lanes). ~300 bigint point ops
-    (~2 ms), amortized over the whole batch; the device equivalent would be
-    sub-tile sequential work costing hundreds of ms.
+    va_limbs: int32[4, NLIMB, 64] and vr_limbs: int32[4, NLIMB, 32] loose
+    X/Y/Z/T limbs from msm_accumulate_kernel (MSB-first window lanes; the
+    R accumulator covers only the low 32 windows because z_i < 2^128).
+    ~450 bigint point ops (~2 ms), amortized over the whole batch; the
+    device equivalent would be sub-tile sequential work costing hundreds
+    of ms.
 
     COFACTORED (the [8]·): torsion components of adversarial A/R cancel
     deterministically, so acceptance never depends on the random z_i — a
@@ -77,15 +81,19 @@ def msm_epilogue_check(v_limbs: np.ndarray, sum_s: int, kernel) -> bool:
     library) backends if adversarially-crafted torsion keys are a concern.
     """
     ref = kernel.ref
-    W = v_limbs.shape[2]
+    Wa = va_limbs.shape[2]
+    off = Wa - vr_limbs.shape[2]
+
+    def window_point(v, w):
+        return tuple(kernel.limbs_to_int(v[c, :, w]) % ref.P for c in range(4))
+
     acc = (0, 1, 1, 0)  # identity, extended coordinates
-    for w in range(W):
+    for w in range(Wa):
         for _ in range(4):
             acc = ref.point_double(acc)
-        vw = tuple(
-            kernel.limbs_to_int(v_limbs[c, :, w]) % ref.P for c in range(4)
-        )
-        acc = ref.point_add(acc, vw)
+        acc = ref.point_add(acc, window_point(va_limbs, w))
+        if w >= off:
+            acc = ref.point_add(acc, window_point(vr_limbs, w - off))
     acc = ref.point_add(acc, ref.point_mul(sum_s % ref.L, ref.G))
     for _ in range(3):  # cofactor 8
         acc = ref.point_double(acc)
@@ -149,6 +157,27 @@ class TpuVerifier:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
+            # Fail at CONSTRUCTION, not first dispatch: every bucket this
+            # verifier can ever pad to is a power of two in
+            # [_MIN_BUCKET, max_bucket] (or exactly max_bucket when
+            # fixed_bucket), and the data axis must divide each — a
+            # mis-sized mesh must stop a node at startup the way
+            # verify_rule validation does, not stall it at the first
+            # verify (advisor r4).
+            if data_axis not in mesh.shape:
+                raise ValueError(
+                    f"verifier mesh has no {data_axis!r} axis "
+                    f"(axes: {tuple(mesh.shape)})"
+                )
+            data_size = mesh.shape[data_axis]
+            smallest = self.max_bucket if self.fixed_bucket else _MIN_BUCKET
+            if smallest % data_size != 0 or self.max_bucket % data_size != 0:
+                raise ValueError(
+                    f"verify shard count {data_size} must divide every "
+                    f"dispatch bucket (smallest {smallest}, largest "
+                    f"{self.max_bucket}); use a power of two <= {smallest}"
+                )
+
             def s(*spec):
                 return NamedSharding(mesh, P(*spec))
 
@@ -162,7 +191,8 @@ class TpuVerifier:
                 kernel.msm_accumulate_kernel.__wrapped__,
                 static_argnames=("chunk",),
                 in_shardings=(b2, b1, b2, b1, b2, b2),
-                out_shardings=(s(), b1),  # V replicated (reduced), valid sharded
+                # V_a/V_r replicated (cross-device reduced), valid sharded.
+                out_shardings=(s(), s(), b1),
             )
         else:
             self._item_kernel = kernel.verify_batch_kernel
@@ -320,7 +350,7 @@ class TpuVerifier:
             if self.mode == "msm" and bucket >= self.msm_min_bucket:
                 out = self._dispatch_msm(packed, lo, hi, pad)
                 kind = "msm"
-                arrays = out[0]  # ((V, valid), sum_s)
+                arrays = out[0]  # ((V_a, V_r, valid), sum_s)
             else:
                 out = self._dispatch_items(packed, lo, hi, pad)
                 kind = "item"
@@ -551,31 +581,56 @@ class TpuVerifier:
                 pass
         return (out, sum_s)
 
+    def _chunk_passes(self, dispatched) -> bool:
+        """Force one `_dispatch_group_chunk` result: device validity lanes
+        plus the host epilogue identity."""
+        if dispatched is None:
+            return False
+        (va_dev, vr_dev, valid_dev), sum_s = dispatched
+        valid = np.asarray(valid_dev)
+        return bool(valid.all()) and msm_epilogue_check(
+            np.asarray(va_dev), np.asarray(vr_dev), sum_s, self.kernel
+        )
+
     def collect_groups(self, handle) -> list[bool]:
-        """Resolve a `submit_groups` handle. A failed combined check falls
-        back to per-group host verification (adversarial path only)."""
+        """Resolve a `submit_groups` handle. A failed combined check
+        RE-DISPATCHES each group as its own device msm chunk (all singles
+        in flight before the first readback, so the bisect stays
+        pipelined); only groups whose solo device check still fails reach
+        the pure-Python host verifier. One adversarial compact certificate
+        therefore costs the attacker's own group a host walk — it cannot
+        drag every honest group in the chunk onto the 1-core host (the
+        r4-advisor liveness-DoS amplification). Oversized groups (2 rows
+        per signer > max_bucket — a committee larger than half the service
+        bucket) still host-verify; splitting one group's epilogue identity
+        across dispatches isn't supported."""
         from ..types import host_verify_aggregate
 
         ok, candidates, outs, groups = handle
         for chunk, dispatched in outs:
-            passed = False
-            if dispatched is not None:
-                (v_dev, valid_dev), sum_s = dispatched
-                valid = np.asarray(valid_dev)
-                if bool(valid.all()) and msm_epilogue_check(
-                    np.asarray(v_dev), sum_s, self.kernel
-                ):
-                    passed = True
-            if passed:
+            if self._chunk_passes(dispatched):
                 for g, *_ in chunk:
                     ok[g] = True
-            else:
+                continue
+            if len(chunk) > 1:
                 logger.warning(
                     "aggregate chunk of %d certificate groups failed the "
-                    "combined check; re-verifying each on host",
+                    "combined check; re-dispatching each group solo",
                     len(chunk),
                 )
-                for g, items, zs, s_agg, _ in chunk:
+                solos = [
+                    (entry, self._dispatch_group_chunk([entry], 2 * len(entry[1])))
+                    for entry in chunk
+                ]
+            else:
+                solos = [(chunk[0], dispatched)]
+            for (g, items, zs, s_agg, _), disp in solos:
+                if len(chunk) > 1 and self._chunk_passes(disp):
+                    ok[g] = True
+                else:
+                    # The group's own device check failed: almost surely
+                    # invalid, but the host verdict is authoritative for
+                    # the rare device-fault case.
                     ok[g] = host_verify_aggregate(items, zs, s_agg)
         # Oversized/empty groups never dispatched: host-verify them too.
         dispatched_gs = {g for g, *_ in candidates}
@@ -605,10 +660,10 @@ class TpuVerifier:
                 if kind == "item":
                     results[lo:hi] = np.asarray(out[pick])[: hi - lo]
                     continue
-                (v_dev, valid_dev), sum_s = out
+                (va_dev, vr_dev, valid_dev), sum_s = out
                 valid = np.asarray(valid_dev)
                 if bool(valid.all()) and msm_epilogue_check(
-                    np.asarray(v_dev), sum_s, self.kernel
+                    np.asarray(va_dev), np.asarray(vr_dev), sum_s, self.kernel
                 ):
                     results[lo:hi] = True
                 else:
@@ -619,6 +674,42 @@ class TpuVerifier:
 
     def __call__(self, items: Sequence[BatchItem]) -> list[bool]:
         return self.collect(self.submit(items))
+
+
+def data_mesh(shards: int, devices=None):
+    """The verify-sharding mesh: `shards` devices on a 1-axis 'data' mesh
+    (SURVEY §7.8a at §5.8 scale — the certificate analog of --dag-shards).
+    This is THE construction path for sharded verifiers: the node surface
+    (--verify-shards) and the driver dryrun both come through here, so the
+    dryrun's CPU-mesh evidence covers exactly what the CLI wires.
+
+    `devices` pins an explicit list (tests; the dryrun's hermetic device
+    set). By default uses the default backend's devices, falling back to
+    the virtual CPU mesh — loudly — when the backend is too small."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < shards:
+        if devices is not None:
+            raise ValueError(
+                f"--verify-shards {shards} exceeds the {len(devs)} pinned "
+                "devices"
+            )
+        cpus = jax.devices("cpu")
+        if len(cpus) < shards:
+            raise ValueError(
+                f"--verify-shards {shards} exceeds available devices "
+                f"({len(devs)} {devs[0].platform}, {len(cpus)} cpu)"
+            )
+        logger.warning(
+            "--verify-shards %d exceeds the %d-device %s backend; sharding "
+            "over %d virtual CPU devices instead",
+            shards, len(devs), devs[0].platform, shards,
+        )
+        devs = cpus
+    return Mesh(_np.array(devs[:shards]), ("data",))
 
 
 def make_batch_verifier(
@@ -750,17 +841,24 @@ class VerifyService:
         atexit.register(self.shutdown)
 
     @classmethod
-    def shared(cls, mode: str, **kw) -> "VerifyService":
-        """The process-wide instance for an accept-set mode ('item'/'msm').
-        Raises if the device verifier cannot be built — callers decide
-        whether that is fatal (cofactored committees) or fallback-able.
+    def shared(
+        cls, mode: str, shards: int = 1, devices=None, **kw
+    ) -> "VerifyService":
+        """The process-wide instance for an accept-set mode ('item'/'msm')
+        and shard count. Raises if the device verifier cannot be built —
+        callers decide whether that is fatal (cofactored committees) or
+        fallback-able. `shards > 1` (--verify-shards) shards every flush
+        over a `data_mesh`; divisibility against the fixed bucket is
+        validated at construction, so a mis-sized mesh stops the node at
+        startup rather than at its first verify.
 
         The verifier runs fixed-bucket (pad every flush to one shape):
         dispatch cost through a device link is RTT-flat in batch size, and
         one shape means one ~minute jit trace per process instead of one
         per power-of-two flush size — the difference between a committee
         that boots inside its warmup window and one that stalls (r4)."""
-        svc = cls._shared.get(mode)
+        key = f"{mode}:{shards}"
+        svc = cls._shared.get(key)
         if svc is None:
             svc = cls(
                 TpuVerifier(
@@ -768,11 +866,12 @@ class VerifyService:
                     msm_min_bucket=16,
                     mode=mode,
                     fixed_bucket=True,
+                    mesh=data_mesh(shards, devices) if shards > 1 else None,
                 ),
                 max_batch=2048,
                 **kw,
             )
-            cls._shared[mode] = svc
+            cls._shared[key] = svc
         return svc
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:

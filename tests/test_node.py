@@ -374,6 +374,55 @@ def test_verify_rule_validated_at_startup(tmp_path):
         )
 
 
+def test_verify_shards_validated_and_wired(tmp_path):
+    """--verify-shards: a node boots with the VerifyService's flushes
+    sharded over a 'data' CPU mesh (the §7.8a verifier service at §5.8
+    scale), mis-sized shard counts fail AT STARTUP (bucket divisibility,
+    like the verify_rule check), and the flag requires the tpu backend.
+    Also: parameters.cert_format is validated at startup (advisor r4 — a
+    typo must not silently run the 'full' wire form in a 'compact'
+    committee)."""
+    from dataclasses import replace
+
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.node import NodeStorage, PrimaryNode
+    from narwhal_tpu.tpu.verifier import VerifyService
+
+    fx = CommitteeFixture(size=4)
+    auth = fx.authorities[0]
+
+    def make(**kw):
+        return PrimaryNode(
+            auth.keypair,
+            fx.committee,
+            fx.worker_cache,
+            kw.pop("parameters", fx.parameters),
+            NodeStorage(None),
+            **kw,
+        )
+
+    with pytest.raises(ValueError, match="verify-shards"):
+        make(crypto_backend="cpu", verify_shards=2)
+    # 3 does not divide the service's fixed dispatch bucket: the boot must
+    # fail, not the first verify.
+    with pytest.raises(ValueError, match="divide"):
+        make(crypto_backend="tpu", verify_shards=3)
+    with pytest.raises(ValueError, match="cert_format"):
+        make(parameters=replace(fx.parameters, cert_format="compat"))
+
+    node = make(crypto_backend="tpu", verify_shards=2)
+    try:
+        svc = node.crypto_pool
+        assert isinstance(svc, VerifyService)
+        assert svc.verifier.mesh is not None
+        assert svc.verifier.mesh.shape["data"] == 2
+        # Catch-up sync shares the same batched lane (advisor r4).
+        assert node.block_synchronizer.crypto_pool is svc
+    finally:
+        if isinstance(node.crypto_pool, VerifyService):
+            node.crypto_pool.shutdown()
+
+
 def test_cluster_with_tpu_crypto_shared_service(run):
     """crypto_backend="tpu": the whole committee shares ONE process-wide
     VerifyService (merged flushes, pipelined submit/collect threads) —
@@ -392,7 +441,7 @@ def test_cluster_with_tpu_crypto_shared_service(run):
         max_delay=0.002,
     )
     svc.verifier.precompile((16, 32))
-    VerifyService._shared["msm"] = svc
+    VerifyService._shared["msm:1"] = svc
 
     async def scenario():
         cluster = Cluster(size=4, workers=1, crypto_backend="tpu")

@@ -48,6 +48,23 @@ def test_point_ops_match_reference():
     assert ref.point_equal(from_ext(k.pt_double(to_ext(p1))), ref.point_double(p1))
     assert ref.point_equal(from_ext(k.pt_add(to_ext(ref.IDENTITY), to_ext(p1))), p1)
     assert ref.point_equal(from_ext(k.pt_add(to_ext(p1), to_ext(ref.point_neg(p1)))), ref.IDENTITY)
+    # Cached-form addition (the 8-mul hot-path add): same group law.
+    assert ref.point_equal(
+        from_ext(k.pt_add_cached(to_ext(p1), k.pt_cache(to_ext(p2)))),
+        ref.point_add(p1, p2),
+    )
+    assert ref.point_equal(
+        from_ext(k.pt_add_cached(to_ext(p1), k.pt_cache(to_ext(ref.IDENTITY)))), p1
+    )
+    # Z2 == 1 variant (host affine table constants): normalize p2 first.
+    zinv = pow(p2[2], ref.P - 2, ref.P)
+    x2, y2 = p2[0] * zinv % ref.P, p2[1] * zinv % ref.P
+    p2_affine = (x2, y2, 1, x2 * y2 % ref.P)
+    yp, ym, _z, t2d = k.pt_cache(to_ext(p2_affine))
+    assert ref.point_equal(
+        from_ext(k.pt_add_cached_z1(to_ext(p1), (yp, ym, t2d))),
+        ref.point_add(p1, p2),
+    )
 
 
 @pytest.fixture(scope="module")
@@ -390,3 +407,50 @@ def test_group_lane_aggregate_verify(run):
         assert run(scenario(), timeout=120.0) == [True, True, False]
     finally:
         svc.shutdown()
+
+
+def test_group_chunk_bisect_keeps_honest_groups_off_host(monkeypatch):
+    """Advisor r4 (medium): one bad compact cert in a fused chunk must NOT
+    force pure-Python re-verification of every group in that chunk — the
+    failed combined check bisects by re-dispatching each group as its own
+    device msm chunk, and only the still-failing group touches the host
+    verifier (DoS amplification fence: an attacker's bad cert costs the
+    attacker's group a host walk, nobody else's)."""
+    from narwhal_tpu import types as types_mod
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.types import Certificate, Vote
+    from narwhal_tpu.tpu.verifier import TpuVerifier
+
+    fx = CommitteeFixture(size=4)
+
+    def make_group(round_, tamper=False):
+        h = fx.header(author=0, round=round_)
+        signers, sigs = [], []
+        for a in fx.authorities:
+            v = Vote.for_header(h, a.public, a.keypair)
+            signers.append(fx.committee.index_of(a.public))
+            sigs.append(v.signature)
+        cc = Certificate.compact_from_votes(h, tuple(signers), tuple(sigs))
+        if tamper:
+            cc = Certificate(
+                cc.header, cc.signers, cc.signatures,
+                bytes([cc.agg_s[0] ^ 1]) + cc.agg_s[1:],
+            )
+        return cc.aggregate_group(fx.committee)
+
+    groups = [make_group(r) for r in range(1, 4)] + [make_group(4, tamper=True)]
+
+    host_calls = []
+    real_host = types_mod.host_verify_aggregate
+
+    def counting(items, zs, s_agg):
+        host_calls.append(s_agg)
+        return real_host(items, zs, s_agg)
+
+    monkeypatch.setattr(types_mod, "host_verify_aggregate", counting)
+    v = TpuVerifier(max_bucket=64, msm_min_bucket=16, mode="msm")
+    verdicts = v.collect_groups(v.submit_groups(groups))
+    assert verdicts == [True, True, True, False]
+    # Exactly ONE host walk: the attacker's own group.
+    assert len(host_calls) == 1
+    assert host_calls[0] == groups[3][2]
