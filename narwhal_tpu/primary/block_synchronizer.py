@@ -40,7 +40,7 @@ from ..messages import (
 )
 from ..network import NetworkClient, RpcError
 from ..stores import CertificateStore, PayloadStore
-from ..types import Certificate, Digest, PublicKey
+from ..types import Certificate, Digest, InvalidSignatureError, PublicKey
 
 logger = logging.getLogger("narwhal.primary")
 
@@ -90,6 +90,7 @@ class BlockSynchronizer:
         network: NetworkClient,
         parameters: Parameters,
         tx_loopback=None,  # re-inject fetched certificates into the Core
+        crypto_pool=None,  # AsyncVerifierPool/VerifyService: batched verify
     ):
         self.name = name
         self.committee = committee
@@ -99,6 +100,7 @@ class BlockSynchronizer:
         self.network = network
         self.parameters = parameters
         self.tx_loopback = tx_loopback
+        self.crypto_pool = crypto_pool
         self.peers = PeerScores()  # peers.rs standing
 
     # -- peer selection ---------------------------------------------------
@@ -134,6 +136,42 @@ class BlockSynchronizer:
                 found[cert.digest] = cert
         return [found[d] for d in digests if d in found]
 
+    async def _verify_certificate(self, cert: Certificate) -> None:
+        """Certificate.verify with the signature work routed through the
+        node's crypto pool when one is configured (advisor r4: catch-up
+        sync of compact certificates through the pure-Python
+        host_verify_aggregate costs ~one scalar-mul per signer per cert —
+        minutes for a long N=50 round range — while the pool's aggregate
+        lane fuses whole batches into one device dispatch). Semantics match
+        the VerifierStage: structural checks inline, signatures batched."""
+        if self.crypto_pool is None:
+            cert.verify(self.committee, self.worker_cache)
+            return
+        if cert.is_compact:
+            group = cert.aggregate_group(self.committee)
+            if group is None:  # genesis
+                return
+            cert.header.verify(
+                self.committee, self.worker_cache, check_signature=False
+            )
+            results = await asyncio.gather(
+                self.crypto_pool.verify(*cert.header.signature_item()),
+                self.crypto_pool.verify_aggregate(*group),
+            )
+        else:
+            items = cert.verify_items(self.committee)
+            if not items:  # genesis
+                return
+            cert.header.verify(
+                self.committee, self.worker_cache, check_signature=False
+            )
+            items.append(cert.header.signature_item())
+            results = await asyncio.gather(
+                *(self.crypto_pool.verify(*item) for item in items)
+            )
+        if not all(results):
+            raise InvalidSignatureError("fetched certificate failed verification")
+
     async def _fetch_certificates(
         self, digests: list[Digest], timeout: float
     ) -> list[Certificate]:
@@ -165,7 +203,7 @@ class BlockSynchronizer:
                 for cert in certs:
                     if cert.digest in wanted and cert.digest not in collected:
                         try:
-                            cert.verify(self.committee, self.worker_cache)
+                            await self._verify_certificate(cert)
                         except Exception as e:
                             logger.warning("peer sent invalid certificate: %s", e)
                             continue
