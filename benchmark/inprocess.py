@@ -132,14 +132,18 @@ async def run_bench(args) -> dict:
                 print(f"inject {lane}: {e}", file=sys.stderr)
             await asyncio.sleep(max(0.0, 1.0 - (time.time() - tick)))
 
+    from narwhal_tpu.network.rpc import WireStats
+
     t_start = time.time()
     rounds_start = {
         a.name: a.metric("consensus_last_committed_round")
         for a in cluster.authorities[:alive]
     }
+    wire_start = WireStats.snapshot()
     await asyncio.gather(*(inject(lane) for lane in lanes))
     await asyncio.sleep(args.drain_tail)
     window = time.time() - t_start
+    wire_end = WireStats.snapshot()
     # Committed protocol rounds during the window: at committee sizes where
     # this 1-core host cannot push transactions through inside any window
     # (N=50: each round is ~7.5k signed control messages), rounds/s is the
@@ -157,6 +161,8 @@ async def run_bench(args) -> dict:
     await cluster.shutdown()
 
     tps = executed[0] / window if executed[0] else 0.0
+    wire_sent = wire_end["bytes_sent"] - wire_start["bytes_sent"]
+    wire_frames = wire_end["frames_sent"] - wire_start["frames_sent"]
     lat_sorted = sorted(latencies)
 
     def pct(p: float) -> float:
@@ -181,6 +187,16 @@ async def run_bench(args) -> dict:
         "executed_total": executed[0],
         "committed_rounds_in_window": round(committed_rounds, 1),
         "committed_rounds_per_s": round(committed_rounds / window, 4),
+        # Control-plane wire accounting (bytes-per-round is the quantity
+        # the compact certificate form targets at byte-bound committees).
+        "wire_bytes_sent_in_window": wire_sent,
+        "wire_frames_sent_in_window": wire_frames,
+        "wire_bytes_per_round": (
+            round(wire_sent / committed_rounds, 1) if committed_rounds else None
+        ),
+        "wire_frames_per_round": (
+            round(wire_frames / committed_rounds, 1) if committed_rounds else None
+        ),
         "identical_execution_prefix": (
             (lambda L: all(o[:L] == orders[0][:L] for o in orders))(
                 min(len(o) for o in orders)

@@ -1,0 +1,70 @@
+"""Profile the N=50 in-process committee (VERDICT r4 item 4: find the
+frame-path costs that bind the 1-core host, then native-lane them).
+
+    python -m benchmark.profile_n50 [--nodes 50] [--duration 45]
+
+Dumps cProfile stats to --out and prints the top cumulative/tottime
+functions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import cProfile
+import io
+import pstats
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.profile_n50")
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--rate", type=int, default=100)
+    ap.add_argument("--duration", type=int, default=45)
+    ap.add_argument("--out", default="/tmp/narwhal_n50.pstats")
+    ap.add_argument("--crypto-backend", default="cpu")
+    ap.add_argument("--cert-format", default="full")
+    args = ap.parse_args()
+
+    from benchmark.inprocess import run_bench
+
+    bench_args = argparse.Namespace(
+        nodes=args.nodes,
+        workers=1,
+        rate=args.rate,
+        tx_size=512,
+        duration=args.duration,
+        drain_tail=3.0,
+        max_header_delay=0.05,
+        max_batch_delay=0.05,
+        warmup_timeout=600.0,
+        faults=0,
+        consensus_protocol="bullshark",
+        crypto_backend=args.crypto_backend,
+        dag_backend="cpu",
+        dag_shards=1,
+        cert_format=args.cert_format,
+        no_precompile=True,
+    )
+    prof = cProfile.Profile()
+    prof.enable()
+    try:
+        record = asyncio.run(run_bench(bench_args))
+        print(record)
+    except Exception as e:
+        # The warmup/progress assert can fail on a thrashing host; the
+        # frames burned up to that point are exactly the hot control-plane
+        # paths we are profiling, so keep the stats either way.
+        record = {"error": str(e)[:200]}
+        print(record)
+    finally:
+        prof.disable()
+        prof.dump_stats(args.out)
+    for sort in ("tottime", "cumulative"):
+        s = io.StringIO()
+        pstats.Stats(prof, stream=s).sort_stats(sort).print_stats(25)
+        print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

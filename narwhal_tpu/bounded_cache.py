@@ -1,0 +1,70 @@
+"""One bounded-cache primitive for the process-wide hot-path caches.
+
+Three subsystems keep insertion-order (FIFO-evicted) caches: decoded wire
+messages (`messages.decode_message`, byte-budgeted), verified signatures
+(`crypto.verify`, entry-bounded) and decoded store objects
+(`stores.CertificateStore`/`HeaderStore`, entry-bounded). They share this
+implementation so the eviction logic — and its THREAD-SAFETY — lives in
+one place: `crypto.verify` runs on executor threads (AsyncVerifierPool
+dispatches `_host_batch_verify` via run_in_executor), where two concurrent
+evictions over a plain dict double-delete keys and raise KeyError.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class BoundedCache:
+    """Thread-safe insertion-order cache with FIFO eviction.
+
+    `max_entries` bounds the number of keys; `max_bytes` (with per-put
+    `weight`) bounds a byte budget — either or both may be set. Eviction
+    drops the oldest entries until the new item fits. Values must be
+    immutable/shared-safe: a `get` returns the same object to every
+    caller.
+    """
+
+    __slots__ = ("_map", "_weights", "_lock", "_max_entries", "_max_bytes", "_bytes")
+
+    def __init__(self, max_entries: int = 0, max_bytes: int = 0):
+        if not max_entries and not max_bytes:
+            raise ValueError("BoundedCache needs max_entries and/or max_bytes")
+        self._map: dict = {}
+        self._weights: dict = {}
+        self._lock = threading.Lock()
+        self._max_entries = max_entries
+        self._max_bytes = max_bytes
+        self._bytes = 0
+
+    def get(self, key):
+        with self._lock:
+            return self._map.get(key)
+
+    def put(self, key, value, weight: int = 0) -> None:
+        with self._lock:
+            if key in self._map:
+                return  # deterministic values: first write wins
+            while self._map and (
+                (self._max_entries and len(self._map) >= self._max_entries)
+                or (self._max_bytes and self._bytes + weight > self._max_bytes)
+            ):
+                old = next(iter(self._map))  # FIFO: oldest insertion
+                del self._map[old]
+                self._bytes -= self._weights.pop(old, 0)
+            self._map[key] = value
+            if weight:
+                self._weights[key] = weight
+                self._bytes += weight
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._map
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes

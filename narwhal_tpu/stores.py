@@ -11,6 +11,7 @@ from __future__ import annotations
 import struct
 from typing import Iterable
 
+from .bounded_cache import BoundedCache
 from .codec import Reader, Writer
 from .storage import ColumnFamily, StorageEngine
 from .types import (
@@ -27,6 +28,16 @@ from .types import (
 _RK = struct.Struct(">Q")  # big-endian round for ordered iteration
 
 
+# Digest -> decoded object caches (BoundedCache: thread-safe FIFO, shared
+# implementation with the decode/verify caches). The stores are
+# CONTENT-ADDRESSED (the key is the value's digest), so a digest can only
+# ever map to one object and the cache needs no invalidation for
+# correctness; presence/absence still comes from the engine on every
+# read, so deletions behave exactly as before — only the re-decode is
+# skipped. The N=50 profile measured repeated certificate decode at 48%
+# of the host's CPU (1.58M decodes for ~2.5k distinct live certs).
+
+
 class CertificateStore:
     """Certificates by digest + (round, digest) secondary index + notify_read
     (/root/reference/storage/src/certificate_store.rs)."""
@@ -35,6 +46,7 @@ class CertificateStore:
         self._main: ColumnFamily = engine.column_family("certificates")
         self._by_round: ColumnFamily = engine.column_family("certificate_id_by_round")
         self._engine = engine
+        self._decoded = BoundedCache(max_entries=4096)
 
     @staticmethod
     def _round_key(round: Round, origin: PublicKey, digest: Digest) -> bytes:
@@ -58,7 +70,13 @@ class CertificateStore:
 
     def read(self, digest: Digest) -> Certificate | None:
         raw = self._main.get(digest)
-        return Certificate.from_bytes(raw) if raw is not None else None
+        if raw is None:
+            return None
+        cert = self._decoded.get(digest)
+        if cert is None:
+            cert = Certificate.from_bytes(raw)
+            self._decoded.put(digest, cert)
+        return cert
 
     def read_all(self, digests: Iterable[Digest]) -> list[Certificate | None]:
         return [self.read(d) for d in digests]
@@ -68,7 +86,11 @@ class CertificateStore:
 
     async def notify_read(self, digest: Digest) -> Certificate:
         raw = await self._main.notify_read(digest)
-        return Certificate.from_bytes(raw)
+        cert = self._decoded.get(digest)
+        if cert is None:
+            cert = Certificate.from_bytes(raw)
+            self._decoded.put(digest, cert)
+        return cert
 
     def delete(self, digest: Digest) -> None:
         cert = self.read(digest)
@@ -117,16 +139,28 @@ class CertificateStore:
 class HeaderStore:
     def __init__(self, engine: StorageEngine):
         self._cf = engine.column_family("headers")
+        self._decoded = BoundedCache(max_entries=2048)
 
     def write(self, header: Header) -> None:
         self._cf.put(header.digest, header.to_bytes())
 
     def read(self, digest: Digest) -> Header | None:
         raw = self._cf.get(digest)
-        return Header.from_bytes(raw) if raw is not None else None
+        if raw is None:
+            return None
+        header = self._decoded.get(digest)
+        if header is None:
+            header = Header.from_bytes(raw)
+            self._decoded.put(digest, header)
+        return header
 
     async def notify_read(self, digest: Digest) -> Header:
-        return Header.from_bytes(await self._cf.notify_read(digest))
+        raw = await self._cf.notify_read(digest)
+        header = self._decoded.get(digest)
+        if header is None:
+            header = Header.from_bytes(raw)
+            self._decoded.put(digest, header)
+        return header
 
     def delete_all(self, digests: Iterable[Digest]) -> None:
         self._cf.delete_all(digests)

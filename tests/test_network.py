@@ -56,6 +56,39 @@ def test_request_response(run):
     run(scenario())
 
 
+def test_reliable_send_escalates_deadline_for_slow_peer(run):
+    """A slow-but-alive handler must not be retried into congestion
+    collapse: the reliable send escalates its per-attempt deadline, so the
+    handler runs a couple of times, not once per backoff tick (the N=50
+    frame-storm fence)."""
+
+    async def scenario():
+        server = RpcServer()
+        calls = 0
+
+        async def slow(msg, peer):
+            nonlocal calls
+            calls += 1
+            await asyncio.sleep(0.35)  # beyond the first two deadlines
+            return None
+
+        server.route(WorkerBatchMsg, slow)
+        port = await server.start("127.0.0.1", 0)
+        net = NetworkClient(RetryConfig(initial=0.01, max_elapsed=None))
+        handle = net.send(
+            f"127.0.0.1:{port}", WorkerBatchMsg(Batch((b"t",)).to_bytes()),
+            timeout=0.1,  # first deadlines miss; escalation must kick in
+        )
+        assert await asyncio.wait_for(handle.task, 10.0)
+        # Fixed 0.1 s deadlines would need ~4+ handler executions before
+        # luck; escalation (0.1 -> 0.2 -> 0.4) succeeds by the third.
+        assert calls <= 3, calls
+        net.close()
+        await server.stop()
+
+    run(scenario())
+
+
 def test_unreliable_send_to_dead_peer(run):
     async def scenario():
         net = NetworkClient()
