@@ -61,38 +61,49 @@ def test_grpc_end_to_end(run):
                 )
             )
 
-            # 2. Proposer.Rounds until the DAG advances, then NodeReadCausal.
+            # 2+3. Proposer.Rounds / NodeReadCausal / Validator.ReadCausal /
+            # GetCollections, retried until the causal history carries our
+            # submitted payload. Round 2 can be reached by EMPTY headers
+            # before the batch lands in a proposed header — asserting
+            # payload presence at the first observed round was a
+            # load-sensitive race (the r4 full-suite flake); the payload is
+            # guaranteed only eventually, so poll to a deadline.
             api = cluster.authorities[0].primary.grpc_api_address
             chan = grpc.aio.insecure_channel(api)
             channels.append(chan)
             rounds = _unary(chan, "Proposer", "Rounds", pb.RoundsResponse)
             pk = cluster.authorities[0].name
+            nrc = _unary(chan, "Proposer", "NodeReadCausal", pb.NodeReadCausalResponse)
+            rc = _unary(chan, "Validator", "ReadCausal", pb.ReadCausalResponse)
+            gc = _unary(chan, "Validator", "GetCollections", pb.GetCollectionsResponse)
+
             resp = await _wait_rounds(rounds, pk, 2)
             assert resp.newest_round >= 2
+            deadline = asyncio.get_event_loop().time() + 45.0
+            fetched_txs = 0
+            while True:
+                resp = await rounds(pb.RoundsRequest(public_key=pk))
+                causal = await nrc(
+                    pb.NodeReadCausalRequest(public_key=pk, round=resp.newest_round)
+                )
+                assert len(causal.collection_ids) >= 1
+                start = causal.collection_ids[0]
 
-            nrc = _unary(chan, "Proposer", "NodeReadCausal", pb.NodeReadCausalResponse)
-            causal = await nrc(
-                pb.NodeReadCausalRequest(public_key=pk, round=resp.newest_round)
-            )
-            assert len(causal.collection_ids) >= 1
-            start = causal.collection_ids[0]
+                walk = await rc(pb.ReadCausalRequest(collection_id=start))
+                assert start in list(walk.collection_ids)
 
-            # 3. Validator.ReadCausal + GetCollections on a committed digest.
-            rc = _unary(chan, "Validator", "ReadCausal", pb.ReadCausalResponse)
-            walk = await rc(pb.ReadCausalRequest(collection_id=start))
-            assert start in list(walk.collection_ids)
-
-            gc = _unary(chan, "Validator", "GetCollections", pb.GetCollectionsResponse)
-            all_ids = list(causal.collection_ids)
-            got = await gc(pb.CollectionRequest(collection_ids=all_ids))
-            assert len(got.results) == len(all_ids)
-            assert got.results[0].collection_id == all_ids[0]
-            # The causal history up to this round includes our submitted
-            # payload: the fetched collections carry the transactions.
-            fetched_txs = sum(
-                len(b.transactions) for r in got.results for b in r.batches
-            )
-            assert fetched_txs >= 1, got
+                all_ids = list(causal.collection_ids)
+                got = await gc(pb.CollectionRequest(collection_ids=all_ids))
+                assert len(got.results) == len(all_ids)
+                assert got.results[0].collection_id == all_ids[0]
+                fetched_txs = sum(
+                    len(b.transactions) for r in got.results for b in r.batches
+                )
+                if fetched_txs >= 1:
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(f"payload never entered the DAG: {got}")
+                await asyncio.sleep(0.5)
 
             # 4. Configuration: GetPrimaryAddress + NewEpoch is UNIMPLEMENTED.
             gpa = _unary(
