@@ -39,6 +39,27 @@ def enable_compilation_cache() -> None:
             platform = jax.default_backend()
         except Exception:
             platform = "unknown"
+        # Never persist CPU-target executables: XLA:CPU AOT entries encode
+        # compile-machine pseudo-features (+prefer-no-scatter, ...) that
+        # the loader rejects or CRASHES on — entries written by a process
+        # on THIS host SIGSEGV'd the next suite run inside
+        # compilation_cache.get_executable_and_time. The cache's purpose
+        # is the real chip's minutes-long tunnel compiles; CPU-backend
+        # runs (tests, dry runs) rely on in-process caching only. A
+        # process counts as CPU-target when the default backend is cpu,
+        # JAX_PLATFORMS forces cpu, or jax_default_device is pinned to a
+        # cpu device (the conftest/dryrun configurations — their default
+        # backend can still be the accelerator plugin, which would
+        # otherwise mix poisonous cpu entries into the chip's cache dir).
+        forced = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+        pinned = getattr(jax.config, "jax_default_device", None)
+        if (
+            platform == "cpu"
+            or forced.startswith("cpu")
+            or (pinned is not None and getattr(pinned, "platform", "") == "cpu")
+        ):
+            _cache_enabled = True
+            return
         cache_dir = os.path.join(cache_dir, platform)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

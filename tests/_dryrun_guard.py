@@ -1,0 +1,66 @@
+"""Subprocess body of test_dryrun_pins_unsharded_dispatch.
+
+Runs the driver dryrun pinned to the UPPER half of the CPU devices with
+spies on every ed25519 kernel dispatch, and exits non-zero if any kernel
+output lands outside the pinned device list (the MULTICHIP_r02/r04
+failure class). Executed in its own process: the spy run compiles a full
+kernel set for a non-default device, and XLA:CPU's compiler has crashed
+when that compile landed on top of a long-lived suite process's
+accumulated state — isolation keeps the guard deterministic either way.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+import __graft_entry__  # noqa: E402
+import narwhal_tpu.tpu.ed25519 as ed  # noqa: E402
+
+
+def main() -> int:
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        print("SKIP: need 8 cpu devices")
+        return 0
+    allowed = set(cpus[4:8])
+    placements = []
+
+    def spying(kernel):
+        def spy(*args, **kwargs):
+            out = kernel(*args, **kwargs)
+            for leaf in jax.tree_util.tree_leaves(out):
+                placements.extend(leaf.devices())
+            return out
+
+        # The mesh-sharded verifier re-jits kernel.__wrapped__ with
+        # explicit in_shardings; keep that route intact (it is pinned by
+        # construction — the spy watches the *unsharded* dispatch path).
+        spy.__wrapped__ = kernel.__wrapped__
+        return spy
+
+    ed.verify_batch_kernel = spying(ed.verify_batch_kernel)
+    ed.msm_accumulate_kernel = spying(ed.msm_accumulate_kernel)
+    __graft_entry__.dryrun_multichip(4, devices=cpus[4:])
+    if not placements:
+        print("FAIL: the dry run's verifier leg never dispatched a kernel")
+        return 1
+    outside = {str(d) for d in placements if d not in allowed}
+    if outside:
+        print(f"FAIL: dispatch landed outside the pinned device list: {outside}")
+        return 1
+    print("GUARD-OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
