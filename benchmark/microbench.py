@@ -86,13 +86,14 @@ def bench_process_certificates(size: int = 20, rounds: int = 50) -> list[dict]:
 
 
 def bench_dag_service(
-    sizes=(20, 50, 100), rounds: int = 24, concurrency: int = 16
+    sizes=(20, 50, 100), rounds: int = 24, concurrencies=(1, 4, 16)
 ) -> list[dict]:
-    """External Dag service read_causal across committee sizes: host BFS,
-    forced device reach_mask (sequential = the kernel+RTT truth, and
-    `concurrency` coalesced readers sharing one fused dispatch), and the
-    shipped adaptive measured-crossover routing (VERDICT r4 item 5 — the
-    device path must never be *preferred* where it measures slower)."""
+    """External Dag service read_causal across (committee size, concurrent
+    readers): host BFS, forced device reach_mask over the RESIDENT window
+    (concurrent readers coalesce into one fused dispatch), and the shipped
+    adaptive cost-model routing (ISSUE 1 — the device path must win at
+    some measured (size, concurrency) point or be retired; the router must
+    never *prefer* the slower path either way)."""
     import asyncio
 
     from narwhal_tpu.consensus.dag import Dag
@@ -126,60 +127,55 @@ def bench_dag_service(
                 await dag.insert(c)
             tips = certs[-size:]
             await dag.read_causal(tips[-1].digest)  # warm the host path
-            if backend == "tpu":
-                # Warm the device kernel OUTSIDE the timed window for
-                # every policy: the adaptive router serves its first
-                # requests from the host, so without this the kpad=1 jit
-                # compile would land inside the measurement and inflate
-                # the very metric the routing policy is judged on.
-                async with dag._lock:
-                    pos = dag._dev_eligible(tips[-1].digest)
-                    if pos is not None:
-                        dag._device_causal_many([(tips[-1].digest, pos)])
-                        dag._dev_warmed.add(1)
             return dag, tips
 
-        async def run_seq(backend: str, policy: str = "adaptive"):
+        async def run_conc(backend: str, policy: str, c_readers: int):
+            """ms/call at `c_readers` concurrent readers per burst (the
+            device path fuses each burst into one dispatch; the host path
+            serves it sequentially under the service lock)."""
             dag, tips = await make_dag(backend, policy)
+            starts = [tips[-1 - (i % len(tips))].digest for i in range(c_readers)]
+            # Untimed warm bursts: compile the burst-width kpad (and the
+            # resident-window sync kernels) outside the measurement, and
+            # give the adaptive router its first measurements of each path.
+            for _ in range(3):
+                await asyncio.gather(*(dag.read_causal(s) for s in starts))
             n, t0 = 0, time.perf_counter()
-            while time.perf_counter() - t0 < 1.0:
-                await dag.read_causal(tips[-1].digest)
-                n += 1
-            return (time.perf_counter() - t0) / n, dag.routing_stats()
-
-        async def run_coalesced(c_readers: int):
-            dag, tips = await make_dag("tpu", "device")
-            starts = [tips[i % len(tips)].digest for i in range(c_readers)]
-            # Untimed first fused gather: compiles the c_readers-wide kpad.
-            await asyncio.gather(*(dag.read_causal(s) for s in starts))
-            n, t0 = 0, time.perf_counter()
-            while time.perf_counter() - t0 < 1.0:
+            while time.perf_counter() - t0 < 0.8:
                 await asyncio.gather(*(dag.read_causal(s) for s in starts))
                 n += c_readers
             return (time.perf_counter() - t0) / n, dag.routing_stats()
 
-        runs = [
-            ("cpu", lambda: run_seq("cpu")),
-            ("tpu-device", lambda: run_seq("tpu", "device")),
-            ("tpu-adaptive", lambda: run_seq("tpu", "adaptive")),
-            (
-                f"tpu-coalesced{concurrency}",
-                lambda: run_coalesced(concurrency),
-            ),
+        variants = [
+            ("cpu", "cpu", "adaptive"),
+            ("tpu-device", "tpu", "device"),
+            ("tpu-adaptive", "tpu", "adaptive"),
         ]
-        for label, fn in runs:
-            dt, stats = asyncio.run(fn())
-            out.append(
-                {
-                    "metric": f"dag_service_read_causal_ms[{label}]",
-                    "value": round(dt * 1000, 3),
-                    "unit": "ms/call",
-                    "committee": size,
-                    "rounds": rounds,
-                    "routing": stats,
-                }
-            )
+        for conc in concurrencies:
+            for label, backend, policy in variants:
+                dt, stats = asyncio.run(run_conc(backend, policy, conc))
+                out.append(
+                    {
+                        "metric": f"dag_service_read_causal_ms[{label}]",
+                        "value": round(dt * 1000, 3),
+                        "unit": "ms/call",
+                        "committee": size,
+                        "rounds": rounds,
+                        "concurrency": conc,
+                        "backend": _jax_backend(),
+                        "routing": stats,
+                    }
+                )
     return out
+
+
+def _jax_backend() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "unknown"
 
 
 def bench_codec() -> list[dict]:
@@ -224,12 +220,20 @@ def main() -> None:
     ap.add_argument("--profile", action="store_true", help="cProfile the consensus bench")
     ap.add_argument("--dag-service", action="store_true",
                     help="also run the Dag-service read_causal cpu-vs-tpu bench")
+    ap.add_argument("--out", default=None,
+                    help="also write the selected benches as a JSON array to this path")
     args = ap.parse_args()
-    for rec in bench_batch_digest() + bench_codec() + bench_process_certificates():
+    rows = []
+    if not args.dag_service:
+        rows += bench_batch_digest() + bench_codec() + bench_process_certificates()
+    else:
+        rows += bench_dag_service()
+    for rec in rows:
         print(json.dumps(rec))
-    if args.dag_service:
-        for rec in bench_dag_service():
-            print(json.dumps(rec))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(rows, fh, indent=2)
+            fh.write("\n")
     if args.profile:
         prof = cProfile.Profile()
         prof.enable()

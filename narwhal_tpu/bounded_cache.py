@@ -45,6 +45,11 @@ class BoundedCache:
         with self._lock:
             if key in self._map:
                 return  # deterministic values: first write wins
+            if self._max_bytes and weight > self._max_bytes:
+                # An entry that cannot fit even in an empty cache must not
+                # be admitted — evicting the whole working set for it would
+                # both blow the byte budget and trash every warm entry.
+                return
             while self._map and (
                 (self._max_entries and len(self._map) >= self._max_entries)
                 or (self._max_bytes and self._bytes + weight > self._max_bytes)

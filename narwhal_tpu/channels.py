@@ -195,6 +195,26 @@ class BoundedFuturesOrdered:
         return self._queue.qsize()
 
 
+async def drain_cancelled(tasks, timeout: float = 10.0, who: str = "") -> None:
+    """Await already-cancelled tasks with a deadline. A task that ignores
+    its cancellation (e.g. parked on a cancel-immune executor handoff) must
+    not wedge shutdown forever — the reference aborts its tokio tasks and
+    moves on; we warn and abandon. asyncio.wait neither re-cancels nor
+    blocks past the timeout."""
+    import logging
+
+    live = [t for t in tasks if not t.done()]
+    if not live:
+        return
+    _, stuck = await asyncio.wait(live, timeout=timeout)
+    if stuck:
+        logging.getLogger("narwhal.channels").warning(
+            "%s shutdown: abandoning %d task(s) that ignored cancellation",
+            who or "task",
+            len(stuck),
+        )
+
+
 class CancelOnDrop:
     """Handle whose destruction cancels the underlying task
     (/root/reference/network/src/lib.rs:27-47)."""

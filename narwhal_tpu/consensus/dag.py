@@ -22,10 +22,17 @@ when a node switches serving paths mid-stream (advisor r4).
 ROUTING (backend="tpu"): the device path pays a flat dispatch (RTT-bound
 through a tunneled chip) while the host BFS is O(live vertices); neither
 dominates everywhere, so the service MEASURES both and routes each request
-to the faster one (EWMA per path, periodic probing of the loser to track
-drift — the measured-crossover policy of VERDICT r4 item 5). Concurrent
-ReadCausal requests coalesce into ONE vmapped reach_mask dispatch so the
-flat dispatch cost amortizes across every reader in flight.
+through a COST MODEL (VERDICT r5 item 6, refining the r4 measured-crossover
+EWMA): predicted host cost = EWMA(seconds per reported vertex) x live
+vertex count (the walk's footprint tracks the window round-span x committee
+frontier), predicted device cost = EWMA(seconds per fused dispatch) /
+(pending coalesce-queue depth + 1) — the flat dispatch amortizes over every
+reader already waiting for the next flush. The predicted loser is still
+probed periodically so the decision tracks drift. Concurrent
+ReadCausal/NodeReadCausal requests coalesce into ONE vmapped reach_mask
+dispatch over the DEVICE-RESIDENT window (DagWindow.device_view: inserts
+sync as a batched on-device scatter, slides as an on-device roll), so the
+hot path uploads nothing but the [K, N] start onehots.
 """
 
 from __future__ import annotations
@@ -113,6 +120,7 @@ class Dag:
         backend: str = "cpu",  # cpu | tpu: device-resident causal reads
         window: int = 64,
         policy: str = "adaptive",
+        metrics=None,  # ConsensusMetrics: per-route latency + batch gauges
     ):
         self.rx_primary = rx_primary
         self._committee = committee
@@ -138,8 +146,15 @@ class Dag:
         if policy not in ("adaptive", "device", "host"):
             raise ValueError(f"unknown dag routing policy {policy!r}")
         self._policy = policy
-        # Measured-crossover routing state (policy="adaptive").
+        self._metrics = metrics
+        # Cost-model routing state (policy="adaptive"): per-path amortized
+        # per-request EWMAs (stats + cold-start fallbacks) plus the two
+        # model coefficients — host seconds-per-reported-vertex and device
+        # seconds-per-fused-dispatch.
         self._ewma = {"host": None, "dev": None}
+        self._host_pv: float | None = None
+        self._dev_dispatch: float | None = None
+        self._last_batch = 0
         self._routed = {"host": 0, "dev": 0}
         self._route_n = 0
         # Batch sizes whose vmapped kernel has already been traced: the
@@ -154,7 +169,7 @@ class Dag:
         if backend == "tpu":
             from ..tpu.dag_kernels import DagWindow
 
-            self._win = DagWindow(committee, window)
+            self._win = DagWindow(committee, window, device_resident=True)
         for cert in Certificate.genesis(committee):
             self._insert(cert)
 
@@ -257,18 +272,21 @@ class Dag:
     def _device_causal_many(
         self, starts: list[tuple[Digest, tuple[Round, int]]]
     ) -> list[list[Digest]]:
-        """All of `starts` in ONE fused reach_mask dispatch (the coalesced
-        path: K concurrent readers pay one device round trip)."""
+        """All of `starts` in ONE fused reach_mask dispatch over the
+        device-resident window (the coalesced path: K concurrent readers pay
+        one device round trip, and the [W, N, N] adjacency never leaves the
+        device — only the [K, N] onehots upload)."""
         import numpy as np
 
         win = self._win
+        parent_dev, present_dev = win.device_view()
         kpad = _pow2_at_least(len(starts))
         offs = np.zeros((kpad,), np.int32)
         onehots = np.zeros((kpad, win.N), np.uint8)
         for t, (_, (round_, idx)) in enumerate(starts):
             offs[t] = round_ - win.round_base
             onehots[t, idx] = 1
-        masks = np.asarray(self._reach_k(kpad)(win.parent, win.present, offs, onehots))
+        masks = np.asarray(self._reach_k(kpad)(parent_dev, present_dev, offs, onehots))
         out: list[list[Digest]] = []
         for t, (start, _) in enumerate(starts):
             certs: list[Certificate] = []
@@ -296,29 +314,54 @@ class Dag:
         prev = self._ewma[path]
         self._ewma[path] = dt if prev is None else (1 - _ALPHA) * prev + _ALPHA * dt
         self._routed[path] += 1
+        if self._metrics is not None:
+            route = "host" if path == "host" else "device"
+            self._metrics.dag_read_latency.labels(route).observe(dt)
+            self._metrics.dag_read_route_ewma_ms.labels(route).set(
+                self._ewma[path] * 1000
+            )
+
+    def _predict(self, path: str) -> float:
+        """Predicted per-request service time (seconds) for routing one more
+        request down `path` right now — the cost model of the module
+        docstring. Falls back to the plain per-request EWMA until the model
+        coefficient for a path has been measured."""
+        if path == "host":
+            if self._host_pv is not None:
+                return self._host_pv * max(1, len(self._vertices))
+            return self._ewma["host"]
+        if self._dev_dispatch is not None:
+            # One more rider on the next fused dispatch: the flat dispatch
+            # cost splits across everyone already queued plus this request.
+            return self._dev_dispatch / (len(self._dev_queue) + 1)
+        return self._ewma["dev"]
 
     def _pick_path(self) -> str:
-        """host | dev, by measured EWMA (policy='adaptive'). Unmeasured
-        paths get tried once; the measured loser is re-probed every
-        _PROBE_EVERY requests so the decision tracks drift."""
+        """host | dev (policy='adaptive'): route to the cost model's
+        predicted winner. Unmeasured paths get tried once; the predicted
+        loser is re-probed every _PROBE_EVERY requests so the decision
+        tracks load and geometry drift."""
         if self._policy == "device":
             return "dev"
         if self._policy == "host":
             return "host"
-        eh, ed = self._ewma["host"], self._ewma["dev"]
-        if eh is None:
+        if self._ewma["host"] is None:
             return "host"
-        if ed is None:
+        if self._ewma["dev"] is None:
             return "dev"
         self._route_n += 1
-        fast, slow = ("host", "dev") if eh <= ed else ("dev", "host")
+        fast, slow = (
+            ("host", "dev")
+            if self._predict("host") <= self._predict("dev")
+            else ("dev", "host")
+        )
         if self._route_n % _PROBE_EVERY == 0:
             return slow
         return fast
 
     def routing_stats(self) -> dict:
         """The live routing policy, for benchmarks/metrics: per-path call
-        counts and EWMA service time (ms)."""
+        counts, EWMA service times (ms) and the cost-model coefficients."""
         return {
             "policy": self._policy,
             "host_calls": self._routed["host"],
@@ -329,6 +372,14 @@ class Dag:
             "ewma_dev_ms": None
             if self._ewma["dev"] is None
             else round(self._ewma["dev"] * 1000, 3),
+            "host_us_per_vertex": None
+            if self._host_pv is None
+            else round(self._host_pv * 1e6, 3),
+            "dev_dispatch_ms": None
+            if self._dev_dispatch is None
+            else round(self._dev_dispatch * 1000, 3),
+            "last_coalesced_batch": self._last_batch,
+            "live_vertices": len(self._vertices),
         }
 
     # -- commands (consensus/src/dag.rs:370-516) ---------------------------
@@ -395,14 +446,20 @@ class Dag:
         return self._host_causal(start)
 
     def _host_causal(self, start: Digest) -> list[Digest]:
-        """The host BFS, timed into the routing EWMA (lock held)."""
+        """The host BFS, timed into the routing EWMA and the cost model's
+        per-vertex coefficient (lock held)."""
         t0 = time.perf_counter()
         try:
             certs = [v.cert for v in self._dag.bft(start)]
         except (UnknownDigests, DroppedDigest) as e:
             raise ValidatorDagError(str(e)) from e
         out = self._canonical(certs)
-        self._record("host", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._record("host", dt)
+        pv = dt / max(1, len(certs))
+        self._host_pv = (
+            pv if self._host_pv is None else (1 - _ALPHA) * self._host_pv + _ALPHA * pv
+        )
         return out
 
     async def _flush_dev(self) -> None:
@@ -454,9 +511,18 @@ class Dag:
                             fut.set_exception(err)
                 return
             dt = time.perf_counter() - t0
+            self._last_batch = len(eligible)
+            if self._metrics is not None:
+                self._metrics.dag_read_coalesced_batch.set(len(eligible))
             if kpad in self._dev_warmed:
                 # Per-request amortized cost is what competes with one host
-                # BFS in the routing decision.
+                # BFS in the routing decision; the full dispatch wall time
+                # feeds the cost model's amortization term.
+                self._dev_dispatch = (
+                    dt
+                    if self._dev_dispatch is None
+                    else (1 - _ALPHA) * self._dev_dispatch + _ALPHA * dt
+                )
                 for _ in eligible:
                     self._record("dev", dt / len(eligible))
             else:

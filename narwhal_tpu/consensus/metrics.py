@@ -20,3 +20,21 @@ class ConsensusMetrics:
             "consensus_recovered_consensus_state",
             "Times the consensus state was rebuilt from the store at startup",
         )
+        # External Dag service read path (consensus/dag.py): per-route
+        # service latency, the router's live per-request EWMA, and the size
+        # of the most recent fused device dispatch (how many concurrent
+        # readers shared one reach_mask round trip).
+        self.dag_read_latency = registry.histogram(
+            "consensus_dag_read_causal_latency_seconds",
+            "read_causal service time by route (host BFS vs device reach_mask)",
+            labels=("route",),
+        )
+        self.dag_read_route_ewma_ms = registry.gauge(
+            "consensus_dag_read_route_ewma_ms",
+            "EWMA per-request read_causal service time by route, milliseconds",
+            labels=("route",),
+        )
+        self.dag_read_coalesced_batch = registry.gauge(
+            "consensus_dag_read_coalesced_batch_size",
+            "Requests served by the most recent fused device read_causal dispatch",
+        )

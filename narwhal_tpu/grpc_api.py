@@ -20,6 +20,7 @@ import logging
 
 import grpc
 
+from .consensus.dag import ValidatorDagError
 from .proto import narwhal_pb2 as pb
 
 logger = logging.getLogger("narwhal.grpc")
@@ -123,8 +124,14 @@ class GrpcPublicApi:
             )
         try:
             digests = await self.dag.read_causal(request.collection_id)
-        except Exception as e:
+        except ValidatorDagError as e:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:
+            # A dag-internal failure (device dispatch, shutdown race) is not
+            # the caller naming an unknown digest: surface it as INTERNAL so
+            # clients retry elsewhere instead of treating data as absent.
+            logger.exception("ReadCausal failed")
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
         return pb.ReadCausalResponse(collection_ids=list(digests))
 
     # -- Proposer ----------------------------------------------------------
@@ -136,8 +143,11 @@ class GrpcPublicApi:
             )
         try:
             oldest, newest = await self.dag.rounds(bytes(request.public_key))
-        except Exception as e:
+        except ValidatorDagError as e:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:
+            logger.exception("Rounds failed")
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
         return pb.RoundsResponse(oldest_round=oldest, newest_round=newest)
 
     async def _node_read_causal(self, request, context):
@@ -150,8 +160,11 @@ class GrpcPublicApi:
             digests = await self.dag.node_read_causal(
                 bytes(request.public_key), request.round
             )
-        except Exception as e:
+        except ValidatorDagError as e:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except Exception as e:
+            logger.exception("NodeReadCausal failed")
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
         return pb.NodeReadCausalResponse(collection_ids=list(digests))
 
     # -- Configuration -----------------------------------------------------

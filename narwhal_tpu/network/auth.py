@@ -39,11 +39,17 @@ import os
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives import serialization as _ser
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives import serialization as _ser
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pragma: no cover - exercised only without OpenSSL
+    _HAVE_OPENSSL = False
+    _ser = None
 
 from ..crypto import KeyPair, verify
 from ..types import PublicKey
@@ -61,6 +67,127 @@ KIND_AUTH_OK = 5  # server -> client: sig(64)
 
 class AuthError(Exception):
     pass
+
+
+# -- no-OpenSSL fallbacks ----------------------------------------------------
+#
+# Containers without the `cryptography` bindings still need the mesh to
+# authenticate: X25519 is the RFC 7748 montgomery ladder over Python ints
+# (handshake-only, two scalarmults per connection), and the per-frame AEAD is
+# a keyed-blake2b keystream XOR with an encrypt-then-MAC 16-byte tag — the
+# same seal/open framing as AES-GCM, used symmetrically by both endpoints of
+# an in-process mesh, so the wire stays self-consistent. Both sides must run
+# the same build; that is always true for the single-container clusters this
+# fallback exists for.
+
+_X_P = 2**255 - 19
+
+
+def _x25519_scalarmult(k_bytes: bytes, u_bytes: bytes) -> bytes:
+    k = int.from_bytes(k_bytes, "little")
+    k &= (1 << 254) - 8
+    k |= 1 << 254
+    u = int.from_bytes(u_bytes, "little") & ((1 << 255) - 1)
+    x1, x2, z2, x3, z3, swap = u, 1, 0, u, 1, 0
+    for t in reversed(range(255)):
+        kt = (k >> t) & 1
+        if swap ^ kt:
+            x2, x3, z2, z3 = x3, x2, z3, z2
+        swap = kt
+        A = (x2 + z2) % _X_P
+        AA = A * A % _X_P
+        B = (x2 - z2) % _X_P
+        BB = B * B % _X_P
+        E = (AA - BB) % _X_P
+        C = (x3 + z3) % _X_P
+        Dm = (x3 - z3) % _X_P
+        DA = Dm * A % _X_P
+        CB = C * B % _X_P
+        x3 = (DA + CB) % _X_P
+        x3 = x3 * x3 % _X_P
+        z3 = (DA - CB) % _X_P
+        z3 = z3 * z3 % _X_P * x1 % _X_P
+        x2 = AA * BB % _X_P
+        z2 = E * ((AA + 121665 * E) % _X_P) % _X_P
+    if swap:
+        x2, z2 = x3, z3
+    return (x2 * pow(z2, _X_P - 2, _X_P) % _X_P).to_bytes(32, "little")
+
+
+class _RefX25519PublicKey:
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw: bytes):
+        self._raw = raw
+
+    @staticmethod
+    def from_public_bytes(raw: bytes) -> "_RefX25519PublicKey":
+        return _RefX25519PublicKey(raw)
+
+
+class _RefX25519PrivateKey:
+    __slots__ = ("_k", "_pub")
+
+    def __init__(self, k: bytes):
+        self._k = k
+        self._pub = _x25519_scalarmult(k, (9).to_bytes(32, "little"))
+
+    @staticmethod
+    def generate() -> "_RefX25519PrivateKey":
+        return _RefX25519PrivateKey(os.urandom(32))
+
+    def public_key(self) -> _RefX25519PublicKey:
+        return _RefX25519PublicKey(self._pub)
+
+    def exchange(self, peer: _RefX25519PublicKey) -> bytes:
+        return _x25519_scalarmult(self._k, peer._raw)
+
+
+class _HashAEAD:
+    """Encrypt-then-MAC AEAD on keyed blake2b: CTR keystream XOR for
+    confidentiality, 16-byte keyed tag over (nonce, aad, ciphertext) for
+    integrity. Interface-compatible with AESGCM's encrypt/decrypt."""
+
+    __slots__ = ("_key",)
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def _stream(self, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        ctr = 0
+        while len(out) < n:
+            out += hashlib.blake2b(
+                nonce + ctr.to_bytes(8, "little"), key=self._key, digest_size=64
+            ).digest()
+            ctr += 1
+        return bytes(out[:n])
+
+    def _tag(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+        return hashlib.blake2b(
+            len(aad).to_bytes(8, "little") + aad + nonce + ct,
+            key=self._key,
+            digest_size=MAC_LEN,
+        ).digest()
+
+    def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+        ct = bytes(a ^ b for a, b in zip(data, self._stream(nonce, len(data))))
+        return ct + self._tag(nonce, aad, ct)
+
+    def decrypt(self, nonce: bytes, ct_tag: bytes, aad: bytes) -> bytes:
+        import hmac as _hmac
+
+        if len(ct_tag) < MAC_LEN:
+            raise AuthError("sealed frame shorter than its tag")
+        ct, tag = ct_tag[:-MAC_LEN], ct_tag[-MAC_LEN:]
+        if not _hmac.compare_digest(tag, self._tag(nonce, aad, ct)):
+            raise AuthError("frame AEAD authentication failed")
+        return bytes(a ^ b for a, b in zip(ct, self._stream(nonce, len(ct))))
+
+
+if not _HAVE_OPENSSL:
+    X25519PrivateKey = _RefX25519PrivateKey
+    X25519PublicKey = _RefX25519PublicKey
 
 
 @dataclass
@@ -84,10 +211,14 @@ class Session:
     AES-NI speed (~10 GB/s on this host vs ~1.5 GB/s for hash-based MACs)."""
 
     def __init__(self, send_key: bytes, recv_key: bytes):
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        if _HAVE_OPENSSL:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
-        self._send = AESGCM(send_key)
-        self._recv = AESGCM(recv_key)
+            self._send = AESGCM(send_key)
+            self._recv = AESGCM(recv_key)
+        else:
+            self._send = _HashAEAD(send_key)
+            self._recv = _HashAEAD(recv_key)
         self._send_seq = 0
         self._recv_seq = 0
 
@@ -105,7 +236,10 @@ class Session:
     def open_body(self, kind: int, rid: int, tag: int, ct: bytes) -> bytes:
         """Decrypt+verify; raises AuthError on any tampering, injection,
         replay or reordering (the nonce is the expected sequence number)."""
-        from cryptography.exceptions import InvalidTag
+        if _HAVE_OPENSSL:
+            from cryptography.exceptions import InvalidTag
+        else:
+            InvalidTag = AuthError
 
         nonce = self._recv_seq.to_bytes(12, "little")
         try:
@@ -171,7 +305,9 @@ def cached_allow_sets(holder, committee, worker_cache, build):
     return cached[2]
 
 
-def _raw_x25519_pub(priv: X25519PrivateKey) -> bytes:
+def _raw_x25519_pub(priv) -> bytes:
+    if not _HAVE_OPENSSL:
+        return priv.public_key()._raw
     return priv.public_key().public_bytes(_ser.Encoding.Raw, _ser.PublicFormat.Raw)
 
 

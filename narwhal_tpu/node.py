@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from .channels import Channel, metered_channel
+from .channels import Channel, drain_cancelled, metered_channel
 from .config import Committee, Parameters, WorkerCache
 from .consensus import Bullshark, Consensus, Dag, Tusk
 from .consensus.metrics import ConsensusMetrics
@@ -290,7 +290,10 @@ class PrimaryNode:
             # With --dag-backend tpu, ReadCausal/NodeReadCausal run as one
             # device reach_mask dispatch over the dense window.
             self.dag = Dag(
-                committee, self.tx_new_certificates, backend=dag_backend
+                committee,
+                self.tx_new_certificates,
+                backend=dag_backend,
+                metrics=ConsensusMetrics(self.registry),
             )
 
         # Block services + the public consensus API (primary/src/grpc_server).
@@ -398,7 +401,7 @@ class PrimaryNode:
     async def shutdown(self) -> None:
         for t in self._tasks:
             t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await drain_cancelled(self._tasks, who="primary-node")
         await self.api.shutdown()
         await self.grpc_api.shutdown()
         await self.primary.shutdown()

@@ -92,6 +92,12 @@ class Core:
         self.cancel_handlers: dict[Round, list] = {}
         # Channel the certificate waiter listens on; set by the assembly.
         self.tx_certificate_waiter: Channel | None = None
+        # Messages from a FUTURE epoch: our reconfigure notification races
+        # the first new-epoch header over different channels, and dropping
+        # the loser can deadlock the epoch change (every peer drops every
+        # other peer's round-1 header and nobody re-requests it). Hold a
+        # bounded buffer and replay it the moment we adopt the new epoch.
+        self.pending_future_epoch: list[tuple[object, bool]] = []
         self._task: asyncio.Task | None = None
 
     def spawn(self) -> asyncio.Task:
@@ -318,7 +324,20 @@ class Core:
             else:
                 logger.warning("Core received unexpected %r", type(msg))
         except (InvalidEpoch, TooOld) as e:
-            logger.debug("Dropped stale message: %s", e)
+            if (
+                isinstance(e, InvalidEpoch)
+                and getattr(msg, "epoch", 0) == self.committee.epoch + 1
+            ):
+                # Exactly one epoch ahead: our own reconfigure notification
+                # is in flight, not a byzantine replay (anything further
+                # ahead IS dropped — a peer cannot legitimately outrun our
+                # reconfigure by more than one epoch, and a bigger horizon
+                # would let an adversary squat the buffer).
+                if len(self.pending_future_epoch) < 128:
+                    self.pending_future_epoch.append((msg, preverified))
+                logger.debug("Buffered next-epoch message: %s", e)
+            else:
+                logger.debug("Dropped stale message: %s", e)
         except DagError as e:
             logger.warning("Rejected message: %s", e)
 
@@ -363,6 +382,16 @@ class Core:
                         return
                     if note.committee is not None:
                         self.change_epoch(note.committee)
+                        # Replay messages that arrived from this epoch before
+                        # we adopted it (full re-sanitization: anything still
+                        # ahead or now stale re-buffers or drops).
+                        replay, self.pending_future_epoch = (
+                            self.pending_future_epoch, []
+                        )
+                        for m, pv in replay:
+                            await self._handle_message(
+                                PreVerified(m) if pv else m
+                            )
                     recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
                 if round_task in done:
                     committed_round = round_task.result()

@@ -14,7 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..channels import Channel, Watch, metered_channel
+from ..channels import Channel, Watch, drain_cancelled, metered_channel
 from ..config import Committee, Parameters, WorkerCache
 from ..crypto import SignatureService
 from ..messages import (
@@ -388,6 +388,6 @@ class Primary:
             t.cancel()
         for t in self._tasks:
             t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await drain_cancelled(self._tasks, who="primary")
         await self.server.stop()
         self.network.close()

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import time
 
 import jax
@@ -72,6 +73,42 @@ def reach_mask(parent, uncommitted, start_off, start_onehot):
     ws = jnp.arange(W - 1, -1, -1)
     _, rows = lax.scan(step, jnp.zeros((N,), jnp.int32), ws)
     return rows[::-1]  # [W, N] bool, row w = offset w
+
+
+@jax.jit
+def roll_window(parent, present, shift):
+    """Slide the device-resident window by `shift` rounds: drop the oldest
+    `shift` rows and zero the vacated tail. One on-device shuffle instead of
+    a full [W, N, N] host->device re-upload when GC advances the base."""
+    W = present.shape[0]
+    rows = jnp.arange(W, dtype=jnp.int32)
+    keep = rows < (W - shift)
+    present = jnp.roll(present, -shift, axis=0) * keep[:, None].astype(present.dtype)
+    parent = jnp.roll(parent, -shift, axis=0) * keep[:, None, None].astype(parent.dtype)
+    return parent, present
+
+
+@jax.jit
+def place_batch(parent, present, offs, idxs, rows, valid):
+    """Scatter a batch of certificate placements into the device-resident
+    window: for each valid slot t, present[offs[t], idxs[t]] = 1 and
+    parent[offs[t], idxs[t], :] = rows[t]. Padded slots (valid=0) are
+    no-ops, so power-of-two padded batches reuse one compilation per size."""
+
+    def body(carry, inp):
+        parent, present = carry
+        off, idx, row, v = inp
+        live = v.astype(bool)
+        cur_row = parent[off, idx]
+        cur_p = present[off, idx]
+        parent = parent.at[off, idx].set(jnp.where(live, row, cur_row))
+        present = present.at[off, idx].set(
+            jnp.where(live, jnp.uint8(1), cur_p).astype(present.dtype)
+        )
+        return (parent, present), jnp.int32(0)
+
+    (parent, present), _ = lax.scan(body, (parent, present), (offs, idxs, rows, valid))
+    return parent, present
 
 
 @jax.jit
@@ -178,6 +215,7 @@ class DagWindow:
         committee: Committee,
         window: int = 64,
         pad_authorities_to: int | None = None,
+        device_resident: bool = False,
     ):
         self.committee = committee
         n = committee.size()
@@ -191,6 +229,16 @@ class DagWindow:
         self.stakes = stakes
         self.certs: dict[tuple[Round, int], Certificate] = {}
         self.digest_pos: dict[Digest, tuple[Round, int]] = {}
+        # Device-resident mirror (device_resident=True): the tensors live on
+        # device between dispatches; inserts buffer as pending coordinates
+        # and apply as ONE batched on-device scatter at the next
+        # device_view(), window slides as one on-device roll. The hot read
+        # path therefore never re-uploads the [W, N, N] adjacency.
+        self._dev_resident = device_resident
+        self._dev: tuple | None = None
+        self._dev_base: Round = 0
+        self._dev_stale = True
+        self._dev_pending: list[tuple[Round, int]] = []
         # Genesis certificates occupy round 0.
         for cert in Certificate.genesis(committee):
             self._place(cert)
@@ -208,6 +256,8 @@ class DagWindow:
             pos = self.digest_pos.get(pd)
             if pos is not None and pos[0] == cert.round - 1:
                 self.parent[off, idx, pos[1]] = 1
+        if self._dev_resident:
+            self._dev_pending.append((cert.round, idx))
 
     def insert(self, cert: Certificate, keep_floor: Round) -> bool:
         """Add a certificate; slides the window forward (dropping only rounds
@@ -237,6 +287,7 @@ class DagWindow:
         present[: self.W] = self.present
         parent[: self.W] = self.parent
         self.present, self.parent, self.W = present, parent, new_w
+        self._dev_stale = True  # shape change: next device_view re-uploads
 
     def slide_to(self, new_base: Round) -> None:
         shift = new_base - self.round_base
@@ -258,6 +309,57 @@ class DagWindow:
 
     def cert_at(self, round: Round, idx: int) -> Certificate | None:
         return self.certs.get((round, idx))
+
+    # -- device residency --------------------------------------------------
+
+    def device_view(self):
+        """The (parent, present) tensors resident on device, synced to the
+        host mirror. Steady state is incremental: pending placements apply
+        as one power-of-two-padded `place_batch` scatter and a slid base as
+        one `roll_window` shuffle — zero [W, N, N] host->device traffic on
+        the hot path. A full upload happens only on first use and after
+        `_grow` (shape change)."""
+        import jax.numpy as jnp
+
+        if self._dev is None or self._dev_stale:
+            self._dev = (jnp.asarray(self.parent), jnp.asarray(self.present))
+            self._dev_base = self.round_base
+            self._dev_stale = False
+            self._dev_pending.clear()
+            return self._dev
+        parent, present = self._dev
+        if self.round_base != self._dev_base:
+            parent, present = roll_window(
+                parent, present, np.int32(self.round_base - self._dev_base)
+            )
+            self._dev_base = self.round_base
+        if self._dev_pending:
+            # Rows come from the host mirror at sync time, so a placement's
+            # final parent links are always what lands on device; entries
+            # GC'd below the base since they were buffered are dropped.
+            pend = [
+                (r - self.round_base, i)
+                for (r, i) in self._dev_pending
+                if r >= self.round_base
+            ]
+            self._dev_pending.clear()
+            if pend:
+                k = len(pend)
+                kpad = 1 if k <= 1 else 1 << (k - 1).bit_length()
+                offs = np.zeros((kpad,), np.int32)
+                idxs = np.zeros((kpad,), np.int32)
+                rows = np.zeros((kpad, self.N), np.uint8)
+                valid = np.zeros((kpad,), np.uint8)
+                for t, (off, idx) in enumerate(pend):
+                    offs[t] = off
+                    idxs[t] = idx
+                    rows[t] = self.parent[off, idx]
+                    valid[t] = 1
+                parent, present = place_batch(
+                    parent, present, offs, idxs, rows, valid
+                )
+        self._dev = (parent, present)
+        return self._dev
 
 
 class TpuBullshark:
@@ -283,18 +385,30 @@ class TpuBullshark:
         leader_fn=None,
         window: int | None = None,
         mesh=None,
-        prewarm: bool = True,
+        prewarm: bool | None = None,
     ):
         self.committee = committee
         self.store = store
         self.gc_depth = gc_depth
         self._leader_fn = leader_fn
         self.mesh = mesh
+        # Unmeshed engines keep the window resident on device (the meshed
+        # dispatch places operands itself via in_shardings, so it keeps the
+        # host mirror as its operand source).
         self.win = DagWindow(
             committee, window or (gc_depth + 14),
             pad_authorities_to=self._pad_for(committee),
+            device_resident=(mesh is None),
         )
         self._chain_commit = self._build_dispatch()
+        if prewarm is None:
+            # Default only — an explicit prewarm=True/False always wins.
+            # Background compiles contend with foreground jit traces for
+            # XLA's compiler locks; on a single-core host that serializes
+            # every later trace behind a minutes-long compile (and has
+            # wedged concurrent traces outright), so test suites on such
+            # hosts export NARWHAL_TPU_PREWARM=0.
+            prewarm = os.environ.get("NARWHAL_TPU_PREWARM", "1") != "0"
         self._prewarm_enabled = prewarm
         self._prewarm_threads: list = []
         if prewarm:
@@ -555,11 +669,16 @@ class TpuBullshark:
             offs[i] = self.win._off(lr)
             onehots[i, lidx] = 1
 
-        # Numpy operands: the dispatch places them — per in_shardings on the
-        # mesh when configured, on the default device otherwise.
+        # Meshed: numpy operands, placed per in_shardings. Unmeshed: the
+        # device-resident window, so the commit walk uploads nothing but
+        # the per-event scalars and the [Kpad, N] leader onehots.
+        if self.mesh is None:
+            parent_op, present_op = self.win.device_view()
+        else:
+            parent_op, present_op = self.win.parent, self.win.present
         masks_dev = self._chain_commit(
-            self.win.parent,
-            self.win.present,
+            parent_op,
+            present_op,
             np.int32(self.gc_depth),
             self._lc_rel(state),
             np.int32(state.last_committed_round - self.win.round_base),
@@ -600,7 +719,10 @@ class TpuBullshark:
     def update_committee(self, new_committee: Committee) -> None:
         self.committee = new_committee
         self.win = DagWindow(
-            new_committee, self.win.W, pad_authorities_to=self._pad_for(new_committee)
+            new_committee,
+            self.win.W,
+            pad_authorities_to=self._pad_for(new_committee),
+            device_resident=(self.mesh is None),
         )
 
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..channels import Channel, Watch, metered_channel
+from ..channels import Channel, Watch, drain_cancelled, metered_channel
 from ..config import Committee, Parameters, WorkerCache
 from ..messages import (
     CleanupMsg,
@@ -303,7 +303,7 @@ class Worker:
         self.rx_reconfigure.send(ReconfigureNotification("shutdown"))
         for t in self._tasks:
             t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await drain_cancelled(self._tasks, who="worker")
         await self.server.stop()
         await self.tx_server.stop()
         if hasattr(self, "grpc_transactions"):

@@ -5,6 +5,13 @@ import os
 # against an already-registered accelerator plugin (the environment presets
 # JAX_PLATFORMS=axon), so also pin jax_default_device to CPU below.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Background prewarm compiles (TpuBullshark._prewarm) contend with
+# foreground jit traces for XLA's compiler locks: on this 1-core CI host
+# that serializes every later trace behind a minutes-long background
+# compile and has deadlocked main-thread traces mid-suite. Tests compile
+# whatever they actually dispatch; ahead-of-need warming is a production
+# concern.
+os.environ.setdefault("NARWHAL_TPU_PREWARM", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,6 +19,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import asyncio
+import warnings
 
 import pytest
 
@@ -28,9 +36,49 @@ def pytest_configure(config):
 
 @pytest.fixture
 def run():
-    """Run a coroutine to completion on a fresh event loop."""
+    """Run a coroutine to completion on a fresh event loop.
 
-    def _run(coro, timeout=30.0):
-        return asyncio.run(asyncio.wait_for(coro, timeout))
+    Not asyncio.run(): its _cancel_all_tasks cleanup waits FOREVER for
+    leftover tasks to honor their cancellation, so one task parked on a
+    cancel-immune await (e.g. a run_in_executor readback) hangs the whole
+    suite — observed in-suite on the 1-core host. Cleanup here is bounded:
+    cancel leftovers, give them a grace window, then abandon the stragglers
+    with a warning and close the loop."""
+
+    def _run(coro, timeout=30.0, cleanup_grace=15.0):
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+        finally:
+            pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+            for t in pending:
+                t.cancel()
+            stuck = set()
+            if pending:
+                # asyncio.wait with a timeout neither cancels again nor
+                # blocks on stragglers — it just stops waiting.
+                _, stuck = loop.run_until_complete(
+                    asyncio.wait(pending, timeout=cleanup_grace)
+                )
+                if stuck:
+                    warnings.warn(
+                        f"abandoning {len(stuck)} task(s) that ignored "
+                        f"cancellation for {cleanup_grace}s: "
+                        + ", ".join(repr(t.get_coro()) for t in stuck),
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+            with warnings.catch_warnings():
+                # Abandoned tasks destroyed with the loop are the point of
+                # the bounded cleanup; don't let their teardown chatter
+                # drown the test report.
+                warnings.simplefilter("ignore")
+                loop.run_until_complete(loop.shutdown_asyncgens())
+                if not stuck:
+                    # Joins executor threads with no timeout (3.10): safe
+                    # only when nothing is known to be wedged.
+                    loop.run_until_complete(loop.shutdown_default_executor())
+                asyncio.set_event_loop(None)
+                loop.close()
 
     return _run

@@ -108,6 +108,24 @@ def test_store_decode_cache_content_addressed():
     st.close()
 
 
+def test_bounded_cache_rejects_over_budget_entry():
+    """A put whose weight exceeds max_bytes outright must be refused, not
+    admitted after evicting the entire cache: the budget stays intact and
+    the warm working set survives."""
+    cache = BoundedCache(max_bytes=100)
+    cache.put("a", 1, weight=40)
+    cache.put("b", 2, weight=40)
+    cache.put("huge", 3, weight=200)  # over the whole budget
+    assert "huge" not in cache
+    assert cache.get("huge") is None
+    assert "a" in cache and "b" in cache  # working set untouched
+    assert cache.total_bytes == 80
+    # Exactly-at-budget entries still admit (evicting as needed).
+    cache.put("full", 4, weight=100)
+    assert "full" in cache
+    assert cache.total_bytes <= 100
+
+
 def test_bounded_cache_concurrent_eviction_thread_safety():
     """The r5-review crash scenario: verify() runs on executor threads;
     concurrent evictions over a plain dict double-delete keys. The shared

@@ -24,16 +24,30 @@ def _unary(channel, service, method, reply_cls):
 
 
 async def _wait_rounds(rounds_call, pk, minimum, timeout=30.0):
+    """Poll Rounds until `minimum` is reached. NOT_FOUND is the expected
+    not-yet state (the Dag serves OutOfCertificates until the first
+    certificate for `pk` lands) and UNAVAILABLE covers server startup —
+    both retry until the deadline, mirroring the `168849d` deflake of the
+    e2e payload poll. Any other status is a real failure and raises
+    immediately; on deadline the last gRPC error is part of the report."""
     deadline = asyncio.get_event_loop().time() + timeout
+    last_err = None
     while True:
         try:
             resp = await rounds_call(pb.RoundsRequest(public_key=pk))
             if resp.newest_round >= minimum:
                 return resp
-        except grpc.aio.AioRpcError:
-            pass
+        except grpc.aio.AioRpcError as e:
+            if e.code() not in (
+                grpc.StatusCode.NOT_FOUND,
+                grpc.StatusCode.UNAVAILABLE,
+            ):
+                raise
+            last_err = e
         if asyncio.get_event_loop().time() > deadline:
-            raise AssertionError(f"rounds never reached {minimum}")
+            raise AssertionError(
+                f"rounds never reached {minimum} (last error: {last_err})"
+            )
         await asyncio.sleep(0.2)
 
 

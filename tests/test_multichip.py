@@ -48,18 +48,21 @@ def test_dryrun_multichip_odd_mesh():
 
 
 def test_dryrun_pins_unsharded_dispatch():
-    """MULTICHIP_r04 regression: the unsharded comparison TpuVerifier's
-    module-level jitted kernels dispatched to the *default backend* (the
-    real chip on the bench host — version-skewed that day), so the CPU-mesh
-    correctness artifact went red for a reason unrelated to sharding.
+    """MULTICHIP_r04 regression class: module-level jitted kernels called
+    through library code dispatch to the *process default backend* (the
+    real chip on the bench host — version-skewed that day), not the dry
+    run's pinned devices, so the CPU-mesh correctness artifact went red
+    for a reason unrelated to sharding.
 
     Reproduce the failure mode on the virtual mesh: pin the dry run to the
-    UPPER half of the 8 CPU devices, spy on every ed25519 kernel dispatch,
-    and assert no kernel output ever lands on a device outside the pinned
-    list. Without `jax.default_device(devs[0])` around the dryrun body the
-    unsharded verifier's outputs land on the process default device
-    (cpus[0]) and this test fails — exactly the class of bug the r02/r04
-    artifacts died on, which `devices=cpus` tests structurally cannot see.
+    UPPER half of the 8 CPU devices, spy on the module-level chain_commit
+    dispatch (the route an unmeshed TpuBullshark takes, including its
+    device-resident DagWindow tensors), and assert no kernel output ever
+    lands on a device outside the pinned list. Without
+    `jax.default_device(devs[0])` pinning, those outputs land on the
+    process default device (cpus[0]) and this test fails — exactly the
+    class of bug the r02/r04 artifacts died on, which `devices=cpus`
+    tests structurally cannot see.
 
     Runs in a SUBPROCESS (tests/_dryrun_guard.py): pinning to cpus[4:]
     compiles a second full kernel set for a non-default device, and

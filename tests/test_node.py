@@ -287,6 +287,7 @@ def test_cluster_with_sharded_tpu_dag_backend(run, tmp_path):
     run(scenario(), timeout=90.0)
 
 
+@pytest.mark.slow
 def test_twenty_node_committee_with_faults(run):
     """Committee scaling (BASELINE configs #4-5 risk): a 20-node in-process
     committee commits, and keeps committing after f=6 nodes die (the
@@ -423,6 +424,9 @@ def test_verify_shards_validated_and_wired(tmp_path):
             node.crypto_pool.shutdown()
 
 
+@pytest.mark.slow  # the device-crypto kernel compiles take minutes on a
+# 1-core CPU-backend host (the persistent cache is CPU-disabled); the
+# real-chip twin is the round artifact
 def test_cluster_with_tpu_crypto_shared_service(run):
     """crypto_backend="tpu": the whole committee shares ONE process-wide
     VerifyService (merged flushes, pipelined submit/collect threads) —
@@ -463,6 +467,7 @@ def test_cluster_with_tpu_crypto_shared_service(run):
         svc.shutdown()
 
 
+@pytest.mark.slow  # same compile bill as the shared-service cluster test
 def test_verify_service_merges_and_survives_loops(run):
     """VerifyService is loop-agnostic: requests from sequential event loops
     resolve correctly, bad signatures are rejected, and an msm-mode service

@@ -19,6 +19,9 @@ guards with proptest.
 
 import random as pyrandom
 
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
