@@ -27,6 +27,15 @@ logger = logging.getLogger("narwhal.config")
 Stake = int
 
 
+class ConfigError(ValueError):
+    """Operator-facing misconfiguration (mis-sized shard count, bad flag
+    combination): always fatal at boot, never fallback-able. Distinct from
+    plain ValueError so callers with a documented degradation path (e.g.
+    strict-rule nodes falling back to host crypto when the device verifier
+    fails for NON-config reasons) can re-raise config mistakes while still
+    degrading on environmental ones."""
+
+
 @dataclass
 class Parameters:
     """Tuning knobs (/root/reference/config/src/lib.rs:107-275 defaults)."""

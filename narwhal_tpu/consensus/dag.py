@@ -611,8 +611,8 @@ class Dag:
                 task.cancel()
                 try:
                     await task
-                except asyncio.CancelledError:
-                    pass
+                except asyncio.CancelledError:  # lint: allow(no-silent-except)
+                    pass  # the cancellation we just requested arriving back
         # Cancelling the flush task can strand queued device requests:
         # fail their futures so in-flight read_causal callers error out
         # instead of awaiting forever.

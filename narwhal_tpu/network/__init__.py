@@ -5,6 +5,7 @@ from .rpc import (
     RetryConfig,
     RpcError,
     RpcServer,
+    RpcTimeout,
 )
 
 __all__ = [
@@ -16,6 +17,7 @@ __all__ = [
     "RetryConfig",
     "RpcError",
     "RpcServer",
+    "RpcTimeout",
     "cached_allow_sets",
     "committee_resolver",
 ]

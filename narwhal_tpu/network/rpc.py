@@ -56,6 +56,13 @@ class RpcError(Exception):
     pass
 
 
+class RpcTimeout(RpcError):
+    """The request deadline fired after the transport was up — the peer is
+    slow (or the deadline too tight), not gone. Reliable-send escalates its
+    per-attempt deadline only for this class; connect-refused and other
+    transport failures are instant and must not inflate later deadlines."""
+
+
 class RetryConfig:
     """Exponential backoff (network/src/retry.rs:9-60). max_elapsed=None
     retries forever (the reliable-send policy, p2p.rs:37-41)."""
@@ -239,8 +246,8 @@ class PeerClient:
                         fut.set_exception(RpcError(str(e)))
                 elif kind == KIND_ERR:
                     fut.set_exception(RpcError(body.decode(errors="replace")))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError) as e:
+            logger.debug("connection to %s lost: %r", self.address, e)
         finally:
             self._teardown(RpcError(f"connection to {self.address} lost"))
 
@@ -248,8 +255,8 @@ class PeerClient:
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:
-                pass
+            except Exception:  # lint: allow(no-silent-except)
+                pass  # best-effort close of an already-failed transport
         self._writer = None
         self._reader_task = None
         self._session = None
@@ -277,7 +284,7 @@ class PeerClient:
             raise RpcError(f"send to {self.address} failed: {e}") from e
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)
-            raise RpcError(f"request to {self.address} timed out")
+            raise RpcTimeout(f"request to {self.address} timed out")
 
     def close(self) -> None:
         self._teardown(RpcError("client closed"))
@@ -407,16 +414,16 @@ class RpcServer:
                 )
                 tasks.add(t)
                 t.add_done_callback(lambda t_: (tasks.discard(t_), sem.release()))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError) as e:
+            logger.debug("peer %s disconnected: %r", peer_addr, e)
         finally:
             self._writers.discard(writer)
             for t in tasks:
                 t.cancel()
             try:
                 writer.close()
-            except Exception:
-                pass
+            except Exception:  # lint: allow(no-silent-except)
+                pass  # best-effort close of an already-failed transport
 
     async def _dispatch(
         self,
@@ -443,12 +450,16 @@ class RpcServer:
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            # The peer sees the failure as an ERR frame; keep local
+            # visibility too — a handler bug otherwise only surfaces as
+            # remote retry noise.
+            logger.debug("handler for tag %d raised: %r", tag, e)
             out = (KIND_ERR, rid, 0, str(e).encode())
         try:
             _write_frame(writer, *out, session)
             await writer.drain()
-        except (ConnectionError, OSError):
-            pass
+        except (ConnectionError, OSError) as e:
+            logger.debug("response to %s dropped (peer gone): %r", peer.addr, e)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -463,8 +474,8 @@ class RpcServer:
             for w in list(self._writers):
                 try:
                     w.close()
-                except Exception:
-                    pass
+                except Exception:  # lint: allow(no-silent-except)
+                    pass  # best-effort close during server stop
             await self._server.wait_closed()
             if bound is not None:
                 # A later bind of this port (node restart) may again
@@ -524,20 +535,30 @@ class NetworkClient:
                     await self.peer(address).request(msg, attempt_timeout)
                     return True
                 except (RpcError, OSError) as e:
+                    timed_out = isinstance(e, (RpcTimeout, asyncio.TimeoutError))
                     try:
                         delay = next(delays)
                     except StopIteration:
                         raise RpcError(f"retries to {address} exhausted: {e}") from e
                     await asyncio.sleep(delay)
-                    # A deadline miss on a loaded host usually means the
-                    # peer is SLOW, not gone — resending on a fixed
-                    # deadline re-executes the handler and multiplies load
-                    # (measured at N=50: ~300k frames per committed round,
-                    # mostly retries). Escalate the per-attempt deadline so
-                    # a slow-but-alive peer is retried into success, not
-                    # congestion collapse.
-                    if attempt_timeout is not None:
+                    if attempt_timeout is None:
+                        continue
+                    if timed_out:
+                        # A deadline miss on a loaded host usually means
+                        # the peer is SLOW, not gone — resending on a fixed
+                        # deadline re-executes the handler and multiplies
+                        # load (measured at N=50: ~300k frames per
+                        # committed round, mostly retries). Escalate the
+                        # per-attempt deadline so a slow-but-alive peer is
+                        # retried into success, not congestion collapse.
                         attempt_timeout = min(attempt_timeout * 2.0, timeout * 8.0)
+                    else:
+                        # Connection-refused and friends fail instantly:
+                        # they say nothing about the peer's SPEED, so a
+                        # burst of them (node restarting) must not leave
+                        # later attempts stuck at an 8x deadline once the
+                        # peer is back. Reset to the configured deadline.
+                        attempt_timeout = timeout
 
         task = asyncio.ensure_future(attempt_forever())
         self._send_tasks.add(task)

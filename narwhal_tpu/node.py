@@ -14,7 +14,7 @@ import asyncio
 import logging
 
 from .channels import Channel, drain_cancelled, metered_channel
-from .config import Committee, Parameters, WorkerCache
+from .config import Committee, ConfigError, Parameters, WorkerCache
 from .consensus import Bullshark, Consensus, Dag, Tusk
 from .consensus.metrics import ConsensusMetrics
 from .crypto import KeyPair, SignatureService
@@ -157,8 +157,12 @@ class PrimaryNode:
                 # inside the TpuVerifier constructor, so a mis-sized mesh
                 # fails the boot, not the first dispatch.
                 crypto_pool = VerifyService.shared(mode, shards=verify_shards)
-            except ValueError:
-                raise  # mis-sized shard count: a config error, never fallback
+            except ConfigError:
+                # Mis-sized shard count / bad mesh: operator error, never
+                # fallback. Plain ValueErrors from inside jax/TpuVerifier
+                # device init are ENVIRONMENTAL and fall through to the
+                # documented strict-rule host-crypto degradation below.
+                raise
             except Exception:
                 # Under the cofactored rule the device path is mandatory: a
                 # host fallback would run the STRICT accept set — a

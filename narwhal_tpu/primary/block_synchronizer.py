@@ -198,7 +198,10 @@ class BlockSynchronizer:
             for fut in asyncio.as_completed(tasks, timeout=timeout):
                 try:
                     certs = await fut
-                except (RpcError, OSError, asyncio.TimeoutError):
+                except (RpcError, OSError, asyncio.TimeoutError) as e:
+                    # Individual peer failure: ask() already penalized its
+                    # score; other peers may still satisfy the want-list.
+                    logger.debug("certificate fetch peer failed: %r", e)
                     continue
                 for cert in certs:
                     if cert.digest in wanted and cert.digest not in collected:
@@ -211,7 +214,11 @@ class BlockSynchronizer:
                 if len(collected) == len(wanted):
                     break
         except asyncio.TimeoutError:
-            pass
+            logger.debug(
+                "certificate fetch deadline: %d/%d collected",
+                len(collected),
+                len(wanted),
+            )
         finally:
             for t in tasks:
                 t.cancel()
@@ -267,8 +274,8 @@ class BlockSynchronizer:
             if waiters:
                 try:
                     await asyncio.wait_for(asyncio.gather(*waiters), interval)
-                except asyncio.TimeoutError:
-                    pass  # wait_for already cancelled the gather
+                except asyncio.TimeoutError:  # lint: allow(no-silent-except)
+                    pass  # retry tick by design; wait_for cancelled the gather
             pending = [c for c in pending if missing(c)]
             attempt += 1
         return [c for c in certificates if not missing(c)]

@@ -403,6 +403,8 @@ class Core:
                     task = tasks[key]
                     if task not in done:
                         continue
+                    # Done asyncio task from the select set — result() is a
+                    # completed-task read.  # lint: allow(no-blocking-in-async)
                     msg = task.result()
                     tasks[key] = asyncio.ensure_future(ch.recv())
                     if key == "proposer":

@@ -78,6 +78,9 @@ class HeaderWaiter:
             try:
                 address = self.worker_cache.worker(self.name, worker_id).worker_address
             except KeyError:
+                logger.debug(
+                    "no local worker %d to sync %d batches", worker_id, len(digests)
+                )
                 continue
             await self.network.unreliable_send(
                 address, SynchronizeMsg(tuple(digests), author)
@@ -115,8 +118,8 @@ class HeaderWaiter:
                         asyncio.shield(gathered), self.parameters.sync_retry_delay
                     )
                     break
-                except asyncio.TimeoutError:
-                    continue
+                except asyncio.TimeoutError:  # lint: allow(no-silent-except)
+                    continue  # retry tick: re-send sync requests by design
         except asyncio.CancelledError:
             gathered.cancel()
             raise
@@ -150,8 +153,8 @@ class HeaderWaiter:
                         asyncio.shield(gathered), self.parameters.sync_retry_delay
                     )
                     break
-                except asyncio.TimeoutError:
-                    continue
+                except asyncio.TimeoutError:  # lint: allow(no-silent-except)
+                    continue  # retry tick: re-send sync requests by design
         except asyncio.CancelledError:
             gathered.cancel()
             raise

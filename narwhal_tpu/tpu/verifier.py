@@ -31,6 +31,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..config import ConfigError
 from ..crypto import BatchItem
 
 logger = logging.getLogger("narwhal.tpu.verifier")
@@ -165,14 +166,14 @@ class TpuVerifier:
             # verify_rule validation does, not stall it at the first
             # verify (advisor r4).
             if data_axis not in mesh.shape:
-                raise ValueError(
+                raise ConfigError(
                     f"verifier mesh has no {data_axis!r} axis "
                     f"(axes: {tuple(mesh.shape)})"
                 )
             data_size = mesh.shape[data_axis]
             smallest = self.max_bucket if self.fixed_bucket else _MIN_BUCKET
             if smallest % data_size != 0 or self.max_bucket % data_size != 0:
-                raise ValueError(
+                raise ConfigError(
                     f"verify shard count {data_size} must divide every "
                     f"dispatch bucket (smallest {smallest}, largest "
                     f"{self.max_bucket}); use a power of two <= {smallest}"
@@ -693,13 +694,13 @@ def data_mesh(shards: int, devices=None):
     devs = list(devices) if devices is not None else jax.devices()
     if len(devs) < shards:
         if devices is not None:
-            raise ValueError(
+            raise ConfigError(
                 f"--verify-shards {shards} exceeds the {len(devs)} pinned "
                 "devices"
             )
         cpus = jax.devices("cpu")
         if len(cpus) < shards:
-            raise ValueError(
+            raise ConfigError(
                 f"--verify-shards {shards} exceeds available devices "
                 f"({len(devs)} {devs[0].platform}, {len(cpus)} cpu)"
             )

@@ -21,6 +21,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 from functools import cached_property
+from types import MappingProxyType
 from typing import Iterable, Mapping
 
 from .codec import CodecError, Reader, Writer
@@ -222,7 +223,14 @@ class Header:
         author = r.raw(PUBLIC_KEY_LEN)
         rnd = r.u64()
         epoch = r.u64()
-        payload = r.map(lambda r_: r_.raw(DIGEST_LEN), lambda r_: r_.u32())
+        # Decoded headers are shared process-wide by the decode caches
+        # (messages._DECODE_CACHE and the store caches): every hosted node
+        # sees the SAME object, so the payload must be read-only — one
+        # node writing through it would corrupt every other node's view
+        # (ADVICE r5 medium). MappingProxyType keeps dict-speed reads.
+        payload = MappingProxyType(
+            r.map(lambda r_: r_.raw(DIGEST_LEN), lambda r_: r_.u32())
+        )
         parents = frozenset(r.seq(lambda r_: r_.raw(DIGEST_LEN)))
         signature = r.bytes()
         return Header(author, rnd, epoch, payload, parents, signature)
@@ -255,7 +263,7 @@ class Header:
         so local iteration order matches the wire encoding (Writer.sorted_map)
         — executors on every node, including the author and its post-crash
         replay, walk batches in the same order."""
-        canonical = dict(sorted(payload.items()))
+        canonical = MappingProxyType(dict(sorted(payload.items())))
         h = Header(author, round, epoch, canonical, frozenset(parents))
         return Header(
             author, round, epoch, canonical, frozenset(parents), signer.sign(h.digest)

@@ -111,7 +111,8 @@ class QuorumWaiter:
                     pending, return_when=asyncio.FIRST_COMPLETED
                 )
                 for t in done:
-                    total += t.result()
+                    # asyncio.wait's done set — completed-task reads only.
+                    total += t.result()  # lint: allow(no-blocking-in-async)
                     pending.discard(t)
         finally:
             # Remaining reliable sends keep retrying in the background

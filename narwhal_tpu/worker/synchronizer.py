@@ -13,7 +13,7 @@ import asyncio
 import logging
 import time
 
-from ..channels import Channel, Subscriber, Watch
+from ..channels import Channel, Subscriber, Watch, drain_cancelled
 from ..config import Committee, Parameters, WorkerCache
 from ..messages import SynchronizeMsg, WorkerBatchRequest, WorkerBatchResponse
 from ..network import NetworkClient, RpcError
@@ -52,6 +52,10 @@ class WorkerSynchronizer:
         # digest -> (deadline round, target authority, request time)
         self.pending: dict[Digest, tuple[Round, PublicKey, float]] = {}
         self.gc_round: Round = 0
+        # In-flight fetch attempts. A dropped handle here is the shutdown
+        # wedge class: a fetch parked on tx_batch_processor.send after the
+        # processor stopped would never be cancelled.
+        self._fetch_tasks: set[asyncio.Task] = set()
 
     def spawn(self) -> asyncio.Task:
         return asyncio.ensure_future(self.run())
@@ -84,6 +88,9 @@ class WorkerSynchronizer:
         finally:
             timer.cancel()
             cmd.cancel()
+            for t in list(self._fetch_tasks):
+                t.cancel()
+            await drain_cancelled(self._fetch_tasks, who="worker synchronizer")
 
     async def _synchronize(self, msg: SynchronizeMsg) -> None:
         missing = [d for d in msg.digests if not self.store.contains(d)]
@@ -99,7 +106,12 @@ class WorkerSynchronizer:
         except KeyError:
             logger.warning("synchronize target has no worker %d", self.worker_id)
             return
-        asyncio.ensure_future(self._fetch(info.worker_address, tuple(missing)))
+        self._spawn_fetch(info.worker_address, tuple(missing))
+
+    def _spawn_fetch(self, address: str, digests: tuple[Digest, ...]) -> None:
+        task = asyncio.ensure_future(self._fetch(address, digests))
+        self._fetch_tasks.add(task)
+        task.add_done_callback(self._fetch_tasks.discard)
 
     async def _fetch(self, address: str, digests: tuple[Digest, ...]) -> None:
         """One fetch attempt; received batches flow through the others-batch
@@ -142,7 +154,7 @@ class WorkerSynchronizer:
             addresses, min(self.parameters.sync_retry_nodes, len(addresses))
         )
         for addr in chosen:
-            asyncio.ensure_future(self._fetch(addr, tuple(still_missing)))
+            self._spawn_fetch(addr, tuple(still_missing))
 
     def _cleanup(self, round: Round) -> None:
         """Drop pending requests from before the GC round

@@ -1,0 +1,19 @@
+"""Clean fixture: the async-safe counterparts."""
+
+import asyncio
+import time
+
+
+def sync_helper_may_sleep():
+    time.sleep(0.01)  # fine: not an async def body
+
+
+async def well_behaved(channel):
+    await asyncio.sleep(0.5)
+    task = asyncio.ensure_future(channel.recv())
+    done, _ = await asyncio.wait({task})
+    if task in done:
+        return task.result()  # fine: provably an asyncio task spawned here
+    proc = await asyncio.create_subprocess_exec("ls")
+    await proc.wait()
+    return await asyncio.to_thread(sync_helper_may_sleep)

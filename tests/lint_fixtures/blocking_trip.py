@@ -1,0 +1,14 @@
+"""Tripping fixture: blocking primitives inside async def."""
+
+import subprocess
+import time
+from time import sleep as zzz
+
+
+async def stalls_the_loop(executor, path):
+    time.sleep(0.5)  # finding: time.sleep
+    zzz(0.1)  # finding: from-import alias of time.sleep
+    data = open(path).read()  # finding: sync file I/O
+    subprocess.run(["ls"])  # finding: subprocess in async
+    fut = executor.submit(len, data)
+    return fut.result()  # finding: unknown-origin future .result()

@@ -1,0 +1,19 @@
+"""Clean fixture: copy-before-mutate and read-only access."""
+
+from narwhal_tpu.messages import HeaderMsg, decode_message
+
+
+def read_only(tag, body):
+    msg = decode_message(tag, body)
+    return len(msg.header.payload)
+
+
+def copy_then_mutate(msg: HeaderMsg, digest):
+    payload = dict(msg.header.payload)  # private copy
+    payload[digest] = 0
+    return payload
+
+
+def unrelated_object(store, digest):
+    store.index = {}  # fine: not a decoded message
+    store.index[digest] = 1

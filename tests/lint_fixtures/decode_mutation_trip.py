@@ -1,0 +1,18 @@
+"""Tripping fixture: writes into decoded (cache-shared) messages."""
+
+from narwhal_tpu.messages import HeaderMsg, decode_message
+
+
+def corrupt_all_nodes(tag, body, digest):
+    msg = decode_message(tag, body)
+    msg.header = None  # finding: field write on a decoded message
+    return msg
+
+
+def corrupt_payload(msg: HeaderMsg, digest):
+    msg.header.payload[digest] = 0  # finding: nested container write
+    msg.header.payload.update({digest: 1})  # finding: mutator call
+
+
+def direct(tag, body):
+    decode_message(tag, body).header = None  # finding: direct decode write

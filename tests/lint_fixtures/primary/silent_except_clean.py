@@ -1,0 +1,23 @@
+"""Clean fixture: every handler logs, re-raises, or forwards the error."""
+
+import logging
+
+logger = logging.getLogger("narwhal.fixture")
+
+
+async def handles(channel, fut):
+    try:
+        await channel.recv()
+    except ValueError as e:
+        logger.warning("recv failed: %s", e)
+
+    try:
+        await channel.recv()
+    except Exception as e:
+        fut.set_exception(e)  # forwarded, not swallowed
+
+    try:
+        await channel.recv()
+    except OSError:
+        logger.exception("transport failure")
+        raise

@@ -1,0 +1,13 @@
+"""Tripping fixture: swallowed exceptions in a consensus-critical dir."""
+
+
+async def swallows(channel):
+    try:
+        await channel.recv()
+    except ValueError:
+        pass  # finding: silent swallow
+
+    try:
+        await channel.recv()
+    except Exception:  # finding: broad catch, no logging, no re-raise
+        channel.reset()

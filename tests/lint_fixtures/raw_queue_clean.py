@@ -1,0 +1,9 @@
+"""Clean fixture: metered bounded channels only."""
+
+from narwhal_tpu.channels import Channel, metered_channel
+
+
+def build_edges(registry):
+    a = Channel(100)
+    b = metered_channel(registry, "primary", "to_core", 1_000)
+    return a, b

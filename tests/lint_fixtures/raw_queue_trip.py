@@ -1,0 +1,11 @@
+"""Tripping fixture: bare asyncio queues as actor edges."""
+
+import asyncio
+from asyncio import Queue
+
+
+def build_edges():
+    a = asyncio.Queue(maxsize=100)  # finding
+    b = asyncio.LifoQueue()  # finding
+    c = Queue()  # finding: from-import form
+    return a, b, c

@@ -1,0 +1,19 @@
+"""Clean fixture: every spawn keeps a drainable handle."""
+
+import asyncio
+
+
+class Owner:
+    def __init__(self):
+        self._tasks: set[asyncio.Task] = set()
+
+    async def spawn(self, coro_fn):
+        task = asyncio.ensure_future(coro_fn())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def shutdown(self):
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)

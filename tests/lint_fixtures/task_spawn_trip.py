@@ -1,0 +1,10 @@
+"""Tripping fixture: spawned task handles dropped on the floor."""
+
+import asyncio
+
+
+async def fire_and_forget(coro_fn):
+    asyncio.create_task(coro_fn())  # finding: handle dropped
+    asyncio.ensure_future(coro_fn())  # finding: handle dropped
+    loop = asyncio.get_running_loop()
+    loop.create_task(coro_fn())  # finding: handle dropped

@@ -1,0 +1,23 @@
+"""Clean fixture: pure jitted code; host-side helpers may be impure."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    key = jax.random.PRNGKey(0)  # fine: jax.random is pure
+    return pure_helper(x) + jax.random.uniform(key) + n
+
+
+def pure_helper(x):
+    return jnp.tanh(x) * 2
+
+
+def host_benchmark(x):
+    t0 = time.perf_counter()  # fine: not reachable from a jitted root
+    print("host timing", t0)
+    return kernel(x, 1)

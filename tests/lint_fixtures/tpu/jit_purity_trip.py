@@ -1,0 +1,28 @@
+"""Tripping fixture: impurity reachable from a jitted root."""
+
+import time
+from functools import partial
+
+import jax
+
+CACHE = {}
+
+
+@partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    return helper(x) + n
+
+
+def helper(x):
+    print("tracing", x)  # finding: print reachable from jitted `kernel`
+    CACHE["t"] = time.time()  # findings: global mutation + time call
+    return x * 2
+
+
+def late_wrapped(x):
+    import random
+
+    return x * random.random()  # finding: host RNG under jit
+
+
+fast = jax.jit(late_wrapped)

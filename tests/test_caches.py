@@ -26,6 +26,33 @@ def test_decode_cache_shares_identical_bodies():
     assert a.header == msg.header
 
 
+def test_decoded_payloads_are_immutable():
+    """The decode cache hands ONE object to every hosted node; a write
+    through it would corrupt all of their views (ADVICE r5 medium). The
+    payload mapping must therefore refuse mutation outright — for the
+    cached copy AND for locally built headers (whose digest is cached)."""
+    fx = CommitteeFixture(size=4)
+    tag, body = encode_message(HeaderMsg(fx.header(author=0, round=1)))
+    a = decode_message(tag, bytes(body))
+    b = decode_message(tag, bytes(body))
+    assert a is b
+    some_digest = next(iter(a.header.payload), b"\0" * 32)
+    with pytest.raises(TypeError):
+        # Deliberate mutation attempt: proving the runtime guard fires.
+        a.header.payload[some_digest] = 99  # lint: allow(no-shared-decode-mutation)
+    with pytest.raises(AttributeError):
+        a.header.payload.clear()  # lint: allow(no-shared-decode-mutation)
+    # Locally built (proposer-path) headers are frozen too: their digest
+    # is a cached_property, so post-build payload writes would desync the
+    # signed digest from the contents.
+    built = fx.header(author=1, round=1)
+    with pytest.raises(TypeError):
+        built.payload[some_digest] = 99
+    # Reads stay dict-shaped for every consumer.
+    assert len(list(a.header.payload.items())) == len(a.header.payload)
+    assert dict(a.header.payload) == dict(a.header.payload)
+
+
 def test_decode_cache_budget_and_large_body_bypass(monkeypatch):
     fx = CommitteeFixture(size=4)
     tag, body = encode_message(HeaderMsg(fx.header(author=0, round=2)))

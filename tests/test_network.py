@@ -14,7 +14,13 @@ from narwhal_tpu.messages import (
     WorkerBatchRequest,
     WorkerBatchResponse,
 )
-from narwhal_tpu.network import NetworkClient, RetryConfig, RpcError, RpcServer
+from narwhal_tpu.network import (
+    NetworkClient,
+    RetryConfig,
+    RpcError,
+    RpcServer,
+    RpcTimeout,
+)
 from narwhal_tpu.fixtures import CommitteeFixture
 from narwhal_tpu.types import Batch
 
@@ -85,6 +91,65 @@ def test_reliable_send_escalates_deadline_for_slow_peer(run):
         assert calls <= 3, calls
         net.close()
         await server.stop()
+
+    run(scenario())
+
+
+class _ScriptedPeer:
+    """PeerClient stand-in: raises the scripted failures in order, then
+    acks, recording the per-attempt deadline the client chose."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.timeouts = []
+
+    async def request(self, msg, timeout):
+        self.timeouts.append(timeout)
+        if self.script:
+            raise self.script.pop(0)
+        return Ack()
+
+    def close(self):
+        pass
+
+
+def test_reliable_send_does_not_escalate_on_connection_refused(run):
+    """Connection-refused fails instantly — it says nothing about the
+    peer's speed, so a restarting peer must keep getting the configured
+    deadline, not an ever-doubling one."""
+
+    async def scenario():
+        net = NetworkClient(RetryConfig(initial=0.001, max_elapsed=None, jitter=0))
+        peer = _ScriptedPeer([ConnectionRefusedError("refused")] * 4)
+        net._peers["127.0.0.1:9"] = peer
+        handle = net.send("127.0.0.1:9", Ack(), timeout=1.0)
+        assert await asyncio.wait_for(handle.task, 5.0)
+        assert peer.timeouts == [1.0] * 5  # never inflated
+        net.close()
+
+    run(scenario())
+
+
+def test_reliable_send_resets_deadline_after_timeout_escalation(run):
+    """Only timeout-class failures escalate, and any non-timeout failure
+    resets the deadline: timeout, timeout -> 1x, 2x, 4x; then a refused
+    connect drops the next attempt back to the configured 1x."""
+
+    async def scenario():
+        net = NetworkClient(RetryConfig(initial=0.001, max_elapsed=None, jitter=0))
+        peer = _ScriptedPeer(
+            [
+                RpcTimeout("slow"),
+                RpcTimeout("slow"),
+                ConnectionRefusedError("restarting"),
+                RpcTimeout("slow"),
+            ]
+        )
+        net._peers["127.0.0.1:9"] = peer
+        handle = net.send("127.0.0.1:9", Ack(), timeout=1.0)
+        assert await asyncio.wait_for(handle.task, 5.0)
+        assert peer.timeouts == [1.0, 2.0, 4.0, 1.0, 2.0]
+        net.close()
 
     run(scenario())
 

@@ -405,8 +405,11 @@ def test_verify_shards_validated_and_wired(tmp_path):
     with pytest.raises(ValueError, match="verify-shards"):
         make(crypto_backend="cpu", verify_shards=2)
     # 3 does not divide the service's fixed dispatch bucket: the boot must
-    # fail, not the first verify.
-    with pytest.raises(ValueError, match="divide"):
+    # fail, not the first verify — and with ConfigError specifically, the
+    # class the node treats as never-fallback-able.
+    from narwhal_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="divide"):
         make(crypto_backend="tpu", verify_shards=3)
     with pytest.raises(ValueError, match="cert_format"):
         make(parameters=replace(fx.parameters, cert_format="compat"))
@@ -422,6 +425,46 @@ def test_verify_shards_validated_and_wired(tmp_path):
     finally:
         if isinstance(node.crypto_pool, VerifyService):
             node.crypto_pool.shutdown()
+
+
+def test_environmental_valueerror_keeps_host_crypto_fallback(tmp_path, monkeypatch):
+    """ADVICE r5 low (node.py:160): a ValueError escaping VerifyService
+    device init for NON-config reasons (a jax backend hiccup, not operator
+    error) must keep the documented strict-rule host-crypto fallback. Only
+    ConfigError skips it; under the cofactored rule ANY failure refuses to
+    start (host fallback would run a different accept set)."""
+    from dataclasses import replace
+
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.node import NodeStorage, PrimaryNode
+    from narwhal_tpu.tpu.verifier import AsyncVerifierPool, VerifyService
+
+    fx = CommitteeFixture(size=4)
+    auth = fx.authorities[0]
+
+    def boom(mode, shards=1, **kw):
+        raise ValueError("XLA backend initialization failed")  # environmental
+
+    monkeypatch.setattr(VerifyService, "shared", boom)
+
+    def make(**kw):
+        return PrimaryNode(
+            auth.keypair,
+            fx.committee,
+            fx.worker_cache,
+            kw.pop("parameters", fx.parameters),
+            NodeStorage(None),
+            **kw,
+        )
+
+    node = make(crypto_backend="tpu")
+    assert isinstance(node.crypto_pool, AsyncVerifierPool)  # degraded, same accept set
+
+    with pytest.raises(RuntimeError, match="refusing to start"):
+        make(
+            crypto_backend="tpu",
+            parameters=replace(fx.parameters, verify_rule="cofactored"),
+        )
 
 
 @pytest.mark.slow  # the device-crypto kernel compiles take minutes on a

@@ -1,0 +1,271 @@
+"""narwhal-lint: the tier-1 static-analysis gate plus per-rule fixtures.
+
+The gate test runs the analyzer over `narwhal_tpu/` and `tests/` and fails
+on any non-baselined finding — this is how the actor/JAX invariants
+(metered channels, non-blocking event loop, drainable task spawns, jit
+purity, immutable decoded messages, no silent excepts) stay machine-checked
+after this PR. Fixture tests pin each rule to one tripping and one clean
+snippet so a rule regression (stops firing / starts overfiring) is caught
+in the same run.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lint_fixtures"
+
+sys.path.insert(0, str(REPO))
+
+from tools.lint import RULES, Baseline, Finding, run_lint  # noqa: E402
+from tools.lint.__main__ import DEFAULT_BASELINE, main  # noqa: E402
+
+
+def lint(*paths, baseline=None, rules=None):
+    return run_lint([str(p) for p in paths], rules=rules, baseline=baseline, root=REPO)
+
+
+# ---------------------------------------------------------------------------
+# The gate
+# ---------------------------------------------------------------------------
+
+
+def test_tree_has_no_new_findings():
+    """`python -m tools.lint narwhal_tpu/ tests/` must be clean modulo the
+    checked-in baseline. If this fails: fix the finding, suppress it with a
+    justified `# lint: allow(<rule>)`, or (last resort) regenerate the
+    baseline via `python -m tools.lint --write-baseline narwhal_tpu/ tests/`."""
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    result = lint(REPO / "narwhal_tpu", REPO / "tests", baseline=baseline)
+    assert result.files_scanned > 50  # the walk found the tree
+    details = "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new
+    )
+    assert not result.new, f"new lint findings:\n{details}"
+
+
+def test_baseline_has_no_stale_entries():
+    """Grandfathered findings that get fixed must leave the baseline too,
+    or the file silently re-authorizes a future regression."""
+    baseline = Baseline.load(DEFAULT_BASELINE)
+    result = lint(REPO / "narwhal_tpu", REPO / "tests", baseline=baseline)
+    assert not result.stale_baseline, (
+        f"stale baseline entries (regenerate with --write-baseline): "
+        f"{result.stale_baseline}"
+    )
+
+
+def test_full_run_is_fast():
+    """The analyzer must stay cheap enough to gate every tier-1 run."""
+    t0 = time.perf_counter()
+    lint(REPO / "narwhal_tpu", REPO / "tests")
+    assert time.perf_counter() - t0 < 10.0
+
+
+# ---------------------------------------------------------------------------
+# Rule catalog / fixtures
+# ---------------------------------------------------------------------------
+
+EXPECTED_RULES = {
+    "no-blocking-in-async",
+    "no-raw-queue",
+    "tracked-task-spawn",
+    "jit-purity",
+    "no-shared-decode-mutation",
+    "no-silent-except",
+}
+
+FIXTURE_FOR = {
+    "no-blocking-in-async": ("blocking_trip.py", "blocking_clean.py"),
+    "no-raw-queue": ("raw_queue_trip.py", "raw_queue_clean.py"),
+    "tracked-task-spawn": ("task_spawn_trip.py", "task_spawn_clean.py"),
+    "jit-purity": ("tpu/jit_purity_trip.py", "tpu/jit_purity_clean.py"),
+    "no-shared-decode-mutation": (
+        "decode_mutation_trip.py",
+        "decode_mutation_clean.py",
+    ),
+    "no-silent-except": (
+        "primary/silent_except_trip.py",
+        "primary/silent_except_clean.py",
+    ),
+}
+
+
+def test_rule_catalog_is_complete():
+    assert EXPECTED_RULES <= set(RULES), sorted(RULES)
+    assert set(FIXTURE_FOR) == EXPECTED_RULES
+    for rule in RULES.values():
+        assert rule.summary, f"{rule.name} has no summary"
+
+
+@pytest.mark.parametrize("rule_name", sorted(EXPECTED_RULES))
+def test_rule_trips_on_fixture(rule_name):
+    trip, _ = FIXTURE_FOR[rule_name]
+    result = lint(FIXTURES / trip, rules={rule_name: RULES[rule_name]})
+    assert result.new, f"{rule_name} found nothing in {trip}"
+    assert all(f.rule == rule_name for f in result.new)
+
+
+@pytest.mark.parametrize("rule_name", sorted(EXPECTED_RULES))
+def test_rule_passes_clean_fixture(rule_name):
+    _, clean = FIXTURE_FOR[rule_name]
+    result = lint(FIXTURES / clean, rules={rule_name: RULES[rule_name]})
+    details = [(f.line, f.message) for f in result.new]
+    assert not result.new, f"{rule_name} overfires on {clean}: {details}"
+
+
+def test_fixture_finding_counts():
+    """Pin the exact trip counts so a rule that silently loses coverage
+    (fires on one pattern but stops on another) is caught, not just total
+    silence."""
+    counts = {
+        "no-blocking-in-async": 5,  # sleep, aliased sleep, open, subprocess, .result()
+        "no-raw-queue": 3,  # Queue, LifoQueue, from-import Queue
+        "tracked-task-spawn": 3,  # create_task, ensure_future, loop.create_task
+        "jit-purity": 4,  # print, time.time, global mutation, random under jit
+        "no-shared-decode-mutation": 4,  # field, nested container, mutator, direct
+        "no-silent-except": 2,  # pass-only swallow, broad unlogged catch
+    }
+    for rule_name, expected in counts.items():
+        trip, _ = FIXTURE_FOR[rule_name]
+        result = lint(FIXTURES / trip, rules={rule_name: RULES[rule_name]})
+        assert len(result.new) == expected, (
+            rule_name,
+            [(f.line, f.message) for f in result.new],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import asyncio\n"
+        "async def g():\n"
+        "    import time\n"
+        "    time.sleep(1)  # lint: allow(no-blocking-in-async)\n"
+    )
+    result = lint(f)
+    assert not result.new
+    assert len(result.suppressed) == 1
+    assert result.suppressed[0].rule == "no-blocking-in-async"
+
+
+def test_preceding_line_suppression(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n"
+        "async def g():\n"
+        "    # warmup only, loop not running yet\n"
+        "    # lint: allow(no-blocking-in-async)\n"
+        "    time.sleep(1)\n"
+    )
+    result = lint(f)
+    assert not result.new and len(result.suppressed) == 1
+
+
+def test_suppression_is_rule_specific(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "import time\n"
+        "async def g():\n"
+        "    time.sleep(1)  # lint: allow(no-raw-queue)\n"
+    )
+    result = lint(f)
+    assert len(result.new) == 1  # wrong rule named -> not suppressed
+
+
+# ---------------------------------------------------------------------------
+# Baseline workflow
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_grandfathers_and_detects_new(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text("import time\nasync def g():\n    time.sleep(1)\n")
+    first = lint(f)
+    assert len(first.new) == 1
+
+    bl_path = tmp_path / "baseline.json"
+    Baseline.dump(first.new, bl_path)
+    grandfathered = lint(f, baseline=Baseline.load(bl_path))
+    assert not grandfathered.new and len(grandfathered.baselined) == 1
+
+    # A NEW finding alongside the baselined one still fails the run, and
+    # the baseline survives the original line moving.
+    f.write_text(
+        "import time\n\nasync def g():\n    time.sleep(1)\n    open('x')\n"
+    )
+    again = lint(f, baseline=Baseline.load(bl_path))
+    assert len(again.baselined) == 1
+    assert len(again.new) == 1 and "open" in again.new[0].snippet
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    ghost = Finding("no-raw-queue", "gone.py", 1, 0, "m", "asyncio.Queue()")
+    Baseline.dump([ghost], bl_path)
+    f = tmp_path / "mod.py"
+    f.write_text("x = 1\n")
+    result = lint(f, baseline=Baseline.load(bl_path))
+    assert result.stale_baseline == [("no-raw-queue", "gone.py", "asyncio.Queue()")]
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    result = lint(f)
+    assert len(result.new) == 1 and result.new[0].rule == "syntax-error"
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    trip = FIXTURES / "raw_queue_trip.py"
+    clean = FIXTURES / "raw_queue_clean.py"
+    env_cwd = str(REPO)
+
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--format", "json", str(trip)],
+        capture_output=True,
+        text=True,
+        cwd=env_cwd,
+    )
+    assert bad.returncode == 1, bad.stderr
+    payload = json.loads(bad.stdout)
+    assert not payload["ok"] and payload["new"]
+    assert {f["rule"] for f in payload["new"]} == {"no-raw-queue"}
+
+    good = subprocess.run(
+        [sys.executable, "-m", "tools.lint", str(clean)],
+        capture_output=True,
+        text=True,
+        cwd=env_cwd,
+    )
+    assert good.returncode == 0, good.stdout + good.stderr
+
+
+def test_cli_list_rules():
+    assert main(["--list-rules"]) == 0
+
+
+def test_fixture_dir_is_excluded_from_directory_walks():
+    """Walking tests/ must skip lint_fixtures/ (so the tripping snippets
+    never fail the gate), while explicit file arguments bypass excludes."""
+    result = lint(REPO / "tests")
+    assert not any("lint_fixtures" in f.path for f in result.new)
+    explicit = lint(FIXTURES / "raw_queue_trip.py")
+    assert explicit.new

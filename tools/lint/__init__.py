@@ -1,0 +1,18 @@
+"""narwhal-lint: in-repo AST analyzer for the actor/JAX invariants.
+
+Usage: `python -m tools.lint [paths...]` — see tools/lint/__main__.py for
+flags and README.md § "Static analysis" for the rule catalog, suppression
+syntax, and the baseline workflow.
+"""
+
+from .engine import (  # noqa: F401
+    DEFAULT_EXCLUDES,
+    Baseline,
+    Finding,
+    Module,
+    Result,
+    discover,
+    parse_module,
+    run_lint,
+)
+from .rules import RULES  # noqa: F401

@@ -1,0 +1,246 @@
+"""narwhal-lint engine: file discovery, suppressions, baseline matching.
+
+The analyzer exists because Narwhal's reliability invariants live *between*
+the lines the interpreter checks: every inter-actor edge must be a metered
+bounded channel, nothing may block the event loop, spawned tasks must stay
+drainable, jitted kernels must be pure, and decoded (cached, shared)
+messages must never be mutated. Each of those was violated at least once
+in rounds 4-5 (shutdown wedge, epoch deadlock, shared decode-cache
+finding); this module makes the whole class machine-checked in tier-1.
+
+Vocabulary:
+
+- **Finding** — one rule violation at one source location. Identity for
+  baseline purposes is (rule, path, stripped source line), NOT the line
+  number, so unrelated edits above a grandfathered finding don't
+  invalidate the baseline.
+- **Suppression** — `# lint: allow(rule-a, rule-b)` on the violating line
+  or on a comment-only line directly above it. Suppressions are the
+  "explicitly intended" channel; the baseline is the "grandfathered,
+  pay down later" channel.
+- **Baseline** — a checked-in JSON multiset of findings that are
+  tolerated. New findings (not suppressed, not in the baseline) fail the
+  run; stale baseline entries are reported so the file can be shrunk.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Sequence
+
+# Directories/files never scanned when *walking* a directory argument.
+# Explicitly listed files are always scanned (so fixture tests can point
+# the engine straight at a tripping snippet).
+DEFAULT_EXCLUDES: tuple[str, ...] = (
+    "lint_fixtures",  # the analyzer's own tripping/clean test snippets
+    "__pycache__",
+    "*_pb2.py",  # generated protobuf modules
+    ".*",
+)
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Za-z0-9_\-*,\s]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # posix-relative to the lint root (repo root in practice)
+    line: int
+    col: int
+    message: str
+    snippet: str  # stripped source line — baseline identity
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+
+@dataclass
+class Module:
+    """One parsed source file plus the pre-scanned suppression map."""
+
+    path: Path
+    rel: str
+    source: str
+    lines: list[str]
+    tree: ast.Module
+    allows: dict[int, set[str]]  # 1-based line -> allowed rule names
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.rel, line, col, message, self.snippet(line))
+
+    def allowed(self, finding: Finding) -> bool:
+        rules = self.allows.get(finding.line, ())
+        return finding.rule in rules or "*" in rules
+
+
+def _scan_allows(lines: list[str]) -> dict[int, set[str]]:
+    allows: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        allows.setdefault(i, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            # Comment-only line: the suppression covers the next line too,
+            # for statements too long to carry a trailing comment.
+            allows.setdefault(i + 1, set()).update(rules)
+    return allows
+
+
+def parse_module(path: Path, root: Path) -> Module | Finding:
+    """Parse one file; a syntax error comes back as a `syntax-error`
+    finding (never baselinable by accident: the snippet is the message)."""
+    try:
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    source = path.read_text(encoding="utf-8")
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return Finding(
+            "syntax-error", rel, e.lineno or 1, e.offset or 0, str(e), ""
+        )
+    return Module(path, rel, source, lines, tree, _scan_allows(lines))
+
+
+def _excluded(part: str, excludes: Sequence[str]) -> bool:
+    return any(fnmatch.fnmatch(part, pat) for pat in excludes)
+
+
+def discover(paths: Iterable[str | Path], excludes: Sequence[str] = DEFAULT_EXCLUDES) -> list[Path]:
+    """Expand path arguments into the ordered list of files to scan.
+    Directory walks honor `excludes`; explicit file arguments do not."""
+    out: list[Path] = []
+    seen: set[Path] = set()
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                rel_parts = f.relative_to(p).parts
+                if any(_excluded(part, excludes) for part in rel_parts):
+                    continue
+                r = f.resolve()
+                if r not in seen:
+                    seen.add(r)
+                    out.append(f)
+        elif p.suffix == ".py":
+            r = p.resolve()
+            if r not in seen:
+                seen.add(r)
+                out.append(p)
+    return out
+
+
+class Baseline:
+    """Multiset of grandfathered findings keyed by (rule, path, snippet)."""
+
+    def __init__(self, entries: Iterable[dict] | None = None):
+        self.entries = list(entries or [])
+        self._budget: Counter = Counter(
+            (e["rule"], e["path"], e["snippet"]) for e in self.entries
+        )
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls()
+        data = json.loads(path.read_text(encoding="utf-8"))
+        return cls(data.get("findings", []))
+
+    @staticmethod
+    def dump(findings: Iterable[Finding], path: Path) -> None:
+        entries = sorted(
+            (
+                {"rule": f.rule, "path": f.path, "snippet": f.snippet}
+                for f in findings
+            ),
+            key=lambda e: (e["path"], e["rule"], e["snippet"]),
+        )
+        path.write_text(
+            json.dumps({"version": 1, "findings": entries}, indent=2) + "\n",
+            encoding="utf-8",
+        )
+
+    def claim(self, finding: Finding) -> bool:
+        """Consume one budget slot for a matching entry, if any remains."""
+        if self._budget[finding.key] > 0:
+            self._budget[finding.key] -= 1
+            return True
+        return False
+
+    def stale(self) -> list[tuple[str, str, str]]:
+        """Entries whose budget was never (fully) consumed."""
+        return sorted(k for k, n in self._budget.items() if n > 0)
+
+
+@dataclass
+class Result:
+    new: list[Finding]  # fail the run
+    baselined: list[Finding]
+    suppressed: list[Finding]
+    stale_baseline: list[tuple[str, str, str]]
+    files_scanned: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def run_lint(
+    paths: Iterable[str | Path],
+    rules: dict | None = None,
+    baseline: Baseline | None = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    root: Path | None = None,
+) -> Result:
+    from .rules import RULES
+
+    rules = RULES if rules is None else rules
+    baseline = baseline or Baseline()
+    root = root or Path.cwd()
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    suppressed: list[Finding] = []
+    files = discover(paths, excludes)
+    for path in files:
+        mod = parse_module(path, root)
+        if isinstance(mod, Finding):  # syntax error
+            new.append(mod)
+            continue
+        for rule in rules.values():
+            for finding in rule.check(mod):
+                if mod.allowed(finding):
+                    suppressed.append(finding)
+                elif baseline.claim(finding):
+                    baselined.append(finding)
+                else:
+                    new.append(finding)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Result(new, baselined, suppressed, baseline.stale(), len(files))

@@ -1,0 +1,54 @@
+"""Reporters: human text (default) and machine JSON (--format json)."""
+
+from __future__ import annotations
+
+import json
+
+from .engine import Result
+
+
+def render_text(result: Result, verbose: bool = False) -> str:
+    out: list[str] = []
+    for f in result.new:
+        out.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] {f.message}")
+        if f.snippet:
+            out.append(f"    {f.snippet}")
+    if result.stale_baseline:
+        out.append("")
+        out.append(
+            f"{len(result.stale_baseline)} stale baseline entr"
+            f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+            "(fixed findings still grandfathered — regenerate with "
+            "--write-baseline):"
+        )
+        for rule, path, snippet in result.stale_baseline:
+            out.append(f"    {path} [{rule}] {snippet}")
+    summary = (
+        f"{result.files_scanned} files scanned: "
+        f"{len(result.new)} new finding{'s' if len(result.new) != 1 else ''}, "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.suppressed)} suppressed"
+    )
+    out.append(summary if not out else "\n" + summary)
+    if verbose and result.suppressed:
+        out.append("suppressed:")
+        for f in result.suppressed:
+            out.append(f"    {f.path}:{f.line}: [{f.rule}]")
+    return "\n".join(out)
+
+
+def render_json(result: Result) -> str:
+    return json.dumps(
+        {
+            "files_scanned": result.files_scanned,
+            "new": [f.to_json() for f in result.new],
+            "baselined": [f.to_json() for f in result.baselined],
+            "suppressed": [f.to_json() for f in result.suppressed],
+            "stale_baseline": [
+                {"rule": r, "path": p, "snippet": s}
+                for r, p, s in result.stale_baseline
+            ],
+            "ok": result.ok,
+        },
+        indent=2,
+    )

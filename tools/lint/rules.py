@@ -1,0 +1,692 @@
+"""narwhal-lint rules — each grounded in a failure this repo actually paid for.
+
+| rule                      | incident it guards against                        |
+|---------------------------|---------------------------------------------------|
+| no-blocking-in-async      | event-loop stalls starving every co-hosted actor  |
+| no-raw-queue              | unmetered actor edges (no depth gauge, no bound)  |
+| tracked-task-spawn        | the PR-1 shutdown wedge: dropped task handles     |
+| jit-purity                | host side effects baked into a traced TPU kernel  |
+| no-shared-decode-mutation | the ADVICE r5 medium: decode-cache corruption     |
+| no-silent-except          | swallowed failures in the consensus-critical dirs |
+
+Rules are pure `ast` visitors over one `Module` at a time; registration is
+import-time via the `@register` decorator so `RULES` is the single catalog
+the CLI, the baseline, and the tests all share. Adding a rule = subclass
+`Rule`, decorate, ship a tripping + clean fixture (see
+tests/lint_fixtures/) — the catalog test enforces the fixture pairing.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePath
+from typing import Iterable, Iterator
+
+from .engine import Finding, Module
+
+RULES: dict[str, "Rule"] = {}
+
+
+def register(cls: type["Rule"]) -> type["Rule"]:
+    rule = cls()
+    assert rule.name not in RULES, f"duplicate rule {rule.name}"
+    RULES[rule.name] = rule
+    return cls
+
+
+class Rule:
+    name: str = ""
+    summary: str = ""
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: Module, node: ast.AST, message: str) -> Finding:
+        return mod.finding(self.name, node, message)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """Render a Name/Attribute chain as 'a.b.c'; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> canonical dotted origin, for both import forms:
+    `import numpy as np` -> {'np': 'numpy'};
+    `from time import sleep as zzz` -> {'zzz': 'time.sleep'}."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def resolve(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """dotted() with the leading segment mapped through the import table,
+    so `sp.run` resolves to `subprocess.run` under `import subprocess as sp`."""
+    d = dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    origin = aliases.get(head)
+    if origin is None:
+        return d
+    return f"{origin}.{rest}" if rest else origin
+
+
+def own_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body WITHOUT descending into nested function
+    definitions (those run on their own schedule, often in executors)."""
+    stack = list(getattr(func, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+def in_dirs(mod: Module, names: frozenset[str]) -> bool:
+    return bool(names.intersection(PurePath(mod.rel).parts))
+
+
+# ---------------------------------------------------------------------------
+# no-blocking-in-async
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoBlockingInAsync(Rule):
+    name = "no-blocking-in-async"
+    summary = (
+        "async def bodies must not call blocking primitives (time.sleep, "
+        "sync file/socket I/O, subprocess, bare future .result()); one "
+        "stalled coroutine starves every actor sharing the loop"
+    )
+
+    BLOCKING = {
+        "time.sleep": "use `await asyncio.sleep(...)`",
+        "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+        "os.popen": "use `await asyncio.create_subprocess_shell(...)`",
+        "subprocess.run": "use asyncio.create_subprocess_exec",
+        "subprocess.call": "use asyncio.create_subprocess_exec",
+        "subprocess.check_call": "use asyncio.create_subprocess_exec",
+        "subprocess.check_output": "use asyncio.create_subprocess_exec",
+        "subprocess.Popen": "use asyncio.create_subprocess_exec",
+        "socket.socket": "use asyncio.open_connection / loop.sock_* APIs",
+        "socket.create_connection": "use asyncio.open_connection",
+        "open": "read/write off the loop (asyncio.to_thread) or pre-open",
+        "input": "never prompt inside an event loop",
+    }
+    _SPAWNERS = {"ensure_future", "create_task"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        aliases = import_aliases(mod.tree)
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            # Names bound from asyncio.ensure_future/create_task in THIS
+            # function: .result() on those is an asyncio.Task read (raises
+            # if pending, never blocks) — the done-task select-loop idiom.
+            safe_tasks: set[str] = set()
+            for node in own_nodes(func):
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in self._SPAWNERS
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            safe_tasks.add(t.id)
+            for node in own_nodes(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = resolve(node.func, aliases)
+                if target in self.BLOCKING:
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"`{target}(...)` blocks the event loop inside "
+                        f"`async def {func.name}`; {self.BLOCKING[target]}",
+                    )
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "result"
+                    and not node.args
+                    and not node.keywords
+                ):
+                    if (
+                        isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in safe_tasks
+                    ):
+                        continue  # provably an asyncio task handle
+                    yield self.finding(
+                        mod,
+                        node,
+                        "`.result()` on a future of unknown origin inside "
+                        f"`async def {func.name}`: a concurrent.futures "
+                        "future blocks the loop. Await it instead; if this "
+                        "is a known-done asyncio task, suppress with "
+                        "`# lint: allow(no-blocking-in-async)`",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# no-raw-queue
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoRawQueue(Rule):
+    name = "no-raw-queue"
+    summary = (
+        "inter-actor edges must be metered bounded Channels (channels.py), "
+        "never bare asyncio queues — the metered_channel.rs discipline: "
+        "every edge has a capacity and a depth gauge"
+    )
+
+    _QUEUES = {"asyncio.Queue", "asyncio.LifoQueue", "asyncio.PriorityQueue"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if mod.path.name == "channels.py":  # the one sanctioned wrapper
+            return
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                target = resolve(node.func, aliases)
+                if target in self._QUEUES:
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"raw `{target}` constructed outside channels.py — "
+                        "actor edges must be metered bounded Channels "
+                        "(channels.Channel / metered_channel) so depth is "
+                        "gauged and backpressure is bounded",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# tracked-task-spawn
+# ---------------------------------------------------------------------------
+
+
+@register
+class TrackedTaskSpawn(Rule):
+    name = "tracked-task-spawn"
+    summary = (
+        "a spawned task whose handle is dropped can neither be cancelled "
+        "nor drained at shutdown (the PR-1 shutdown-wedge class); keep the "
+        "handle in an owner that cancels it"
+    )
+
+    _SPAWNERS = {"create_task", "ensure_future"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)
+                and (
+                    (
+                        isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr in self._SPAWNERS
+                    )
+                    or (
+                        isinstance(node.value.func, ast.Name)
+                        and node.value.func.id in self._SPAWNERS
+                    )
+                )
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"`{dotted(node.value.func) or node.value.func.attr}"
+                    "(...)` drops the task handle — register it with a "
+                    "drainable owner (BoundedExecutor, CancelOnDrop, or an "
+                    "owner task set cancelled on shutdown)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# jit-purity
+# ---------------------------------------------------------------------------
+
+
+@register
+class JitPurity(Rule):
+    name = "jit-purity"
+    summary = (
+        "functions reachable from a @jax.jit root in tpu/ must be pure: "
+        "no print/time/random/global mutation — side effects run once at "
+        "trace time then silently vanish from the compiled kernel"
+    )
+
+    _IMPURE_MODULES = {"time", "random"}
+    _IMPURE_CALLS = {"print", "input"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if "tpu" not in PurePath(mod.rel).parts:
+            return
+        aliases = import_aliases(mod.tree)
+        funcs: dict[str, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, node)
+        module_globals = {
+            t.id
+            for stmt in mod.tree.body
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+            for t in (stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target])
+            if isinstance(t, ast.Name)
+        }
+
+        roots = self._jit_roots(mod.tree, aliases, funcs)
+        # Same-module call-graph BFS from the jitted roots; `via` remembers
+        # which root makes each function traced, for the diagnostic.
+        via: dict[str, str] = {r: r for r in roots}
+        queue = list(roots)
+        while queue:
+            fname = queue.pop()
+            for node in ast.walk(funcs[fname]):
+                if isinstance(node, ast.Call):
+                    callee = None
+                    if isinstance(node.func, ast.Name):
+                        callee = node.func.id
+                    elif isinstance(node.func, ast.Attribute):
+                        callee = node.func.attr  # self.helper(...) style
+                    if callee in funcs and callee not in via:
+                        via[callee] = via[fname]
+                        queue.append(callee)
+
+        for fname, root in via.items():
+            yield from self._check_func(mod, funcs[fname], root, aliases, module_globals)
+
+    def _jit_roots(
+        self, tree: ast.Module, aliases: dict[str, str], funcs: dict[str, ast.AST]
+    ) -> set[str]:
+        jit_names = {"jax.jit", "jit"}
+        roots: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    d = resolve(deco, aliases)
+                    if d in jit_names:
+                        roots.add(node.name)
+                    elif isinstance(deco, ast.Call):
+                        f = resolve(deco.func, aliases)
+                        if f in jit_names:
+                            roots.add(node.name)
+                        elif f in ("partial", "functools.partial") and deco.args:
+                            if resolve(deco.args[0], aliases) in jit_names:
+                                roots.add(node.name)
+            elif isinstance(node, ast.Call):
+                # name = jax.jit(fn) — wrapping a module-level function
+                if resolve(node.func, aliases) in jit_names and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in funcs:
+                        roots.add(arg.id)
+        return roots
+
+    def _check_func(
+        self,
+        mod: Module,
+        func: ast.AST,
+        root: str,
+        aliases: dict[str, str],
+        module_globals: set[str],
+    ) -> Iterator[Finding]:
+        local_names = {a.arg for a in getattr(func, "args", ast.arguments(args=[])).args}
+        for node in ast.walk(func):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        local_names.add(t.id)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Global):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"`global {', '.join(node.names)}` inside `{func.name}` "
+                    f"(reachable from jitted `{root}`): global mutation is "
+                    "invisible to the traced kernel after compilation",
+                )
+            elif isinstance(node, ast.Call):
+                target = resolve(node.func, aliases)
+                if target is None:
+                    continue
+                head = target.split(".")[0]
+                if target in self._IMPURE_CALLS or (
+                    head in self._IMPURE_MODULES and head not in local_names
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"impure call `{target}(...)` in `{func.name}` "
+                        f"(reachable from jitted `{root}`): runs once at "
+                        "trace time, then is baked into / elided from the "
+                        "compiled kernel",
+                    )
+                elif target.startswith(("numpy.random", "np.random")):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"`{target}(...)` in `{func.name}` (reachable from "
+                        f"jitted `{root}`): host RNG is trace-time constant "
+                        "under jit; thread a jax.random key instead",
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    base = t
+                    hops = 0
+                    while isinstance(base, (ast.Attribute, ast.Subscript)):
+                        base = base.value
+                        hops += 1
+                    if (
+                        hops
+                        and isinstance(base, ast.Name)
+                        and base.id in module_globals
+                        and base.id not in local_names
+                    ):
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"mutation of module-level `{base.id}` in "
+                            f"`{func.name}` (reachable from jitted "
+                            f"`{root}`): happens at trace time only, not "
+                            "per kernel invocation",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# no-shared-decode-mutation
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoSharedDecodeMutation(Rule):
+    name = "no-shared-decode-mutation"
+    summary = (
+        "decoded messages are shared process-wide by the decode cache "
+        "(messages._DECODE_CACHE): writing a field of one corrupts every "
+        "hosted node's view (the ADVICE r5 medium)"
+    )
+
+    # Core wire types whose decoded instances flow through the caches.
+    _CORE_TYPES = {"Header", "Certificate", "Vote", "Batch"}
+    # The encode memo is the one sanctioned write (messages.encode_message).
+    _EXEMPT_ATTRS = {"_encoded"}
+    _MUTATORS = {
+        "append", "extend", "insert", "remove", "add", "discard",
+        "update", "setdefault", "pop", "popitem", "clear",
+    }
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        msg_classes = self._message_classes(mod)
+        scopes: list[ast.AST] = [mod.tree] + [
+            n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            tracked = self._tracked_names(scope, msg_classes)
+            for node in self._scope_nodes(scope):
+                yield from self._check_node(mod, node, tracked, msg_classes)
+
+    def _scope_nodes(self, scope: ast.AST) -> Iterator[ast.AST]:
+        if isinstance(scope, ast.Module):
+            # Module scope: top-level statements only; functions are their
+            # own scopes so tracked-name sets don't leak across.
+            for stmt in scope.body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from ast.walk(stmt)
+        else:
+            yield from own_nodes(scope)
+
+    def _message_classes(self, mod: Module) -> set[str]:
+        names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.endswith("messages"):
+                    for a in node.names:
+                        local = a.asname or a.name
+                        if local[:1].isupper():
+                            names.add(local)
+                elif node.module.endswith("types"):
+                    for a in node.names:
+                        local = a.asname or a.name
+                        if local in self._CORE_TYPES:
+                            names.add(local)
+            elif isinstance(node, ast.ClassDef):
+                for deco in node.decorator_list:
+                    if (
+                        isinstance(deco, ast.Call)
+                        and isinstance(deco.func, ast.Name)
+                        and deco.func.id == "message"
+                    ):
+                        names.add(node.name)
+        if mod.path.name in ("types.py", "messages.py"):
+            names.update(self._CORE_TYPES)
+        return names
+
+    def _is_decode_call(self, node: ast.AST, msg_classes: set[str]) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == "decode_message":
+            return True
+        if isinstance(f, ast.Attribute):
+            if f.attr == "decode_message":
+                return True
+            if f.attr in ("decode", "from_bytes") and isinstance(f.value, ast.Name):
+                return f.value.id in msg_classes
+        return False
+
+    def _tracked_names(self, scope: ast.AST, msg_classes: set[str]) -> set[str]:
+        tracked: set[str] = set()
+        args = getattr(scope, "args", None)
+        if args is not None:
+            for a in list(args.args) + list(args.kwonlyargs):
+                ann = a.annotation
+                ann_name = None
+                if isinstance(ann, ast.Name):
+                    ann_name = ann.id
+                elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                    ann_name = ann.value.strip("'\"")
+                if ann_name in msg_classes:
+                    tracked.add(a.arg)
+        for node in self._scope_nodes(scope):
+            if isinstance(node, ast.Assign) and self._is_decode_call(node.value, msg_classes):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tracked.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                ann = node.target
+                if isinstance(node.annotation, ast.Name) and node.annotation.id in msg_classes:
+                    tracked.add(ann.id)
+                elif node.value is not None and self._is_decode_call(node.value, msg_classes):
+                    tracked.add(ann.id)
+        return tracked
+
+    def _root_is_tracked(
+        self, node: ast.AST, tracked: set[str], msg_classes: set[str]
+    ) -> bool:
+        """True if an Attribute/Subscript chain bottoms out at a tracked
+        name or directly at a decode call result."""
+        saw_attr = isinstance(node, ast.Attribute)
+        base = node
+        while isinstance(base, (ast.Attribute, ast.Subscript)):
+            base = base.value
+            if isinstance(base, ast.Attribute):
+                saw_attr = True
+        if not saw_attr:
+            return False
+        if isinstance(base, ast.Name):
+            return base.id in tracked
+        return self._is_decode_call(base, msg_classes)
+
+    def _check_node(
+        self, mod: Module, node: ast.AST, tracked: set[str], msg_classes: set[str]
+    ) -> Iterator[Finding]:
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            else:
+                targets = node.targets
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr in self._EXEMPT_ATTRS
+                ):
+                    continue
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and self._root_is_tracked(
+                    t, tracked, msg_classes
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        "write to a field of a decoded message: decoded "
+                        "objects are shared by the process-wide decode "
+                        "cache across every hosted node — copy before "
+                        "mutating",
+                    )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in self._MUTATORS
+            and isinstance(node.func.value, (ast.Attribute, ast.Subscript))
+            and self._root_is_tracked(node.func.value, tracked, msg_classes)
+        ):
+            yield self.finding(
+                mod,
+                node,
+                f"`.{node.func.attr}(...)` mutates a container inside a "
+                "decoded message shared by the decode cache — copy before "
+                "mutating",
+            )
+
+
+# ---------------------------------------------------------------------------
+# no-silent-except
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoSilentExcept(Rule):
+    name = "no-silent-except"
+    summary = (
+        "in primary/, worker/, consensus/, network/: an except that "
+        "swallows without logging hides the exact failures (wedges, "
+        "deadlocks) rounds 4-5 spent days reconstructing from timeouts"
+    )
+
+    _SCOPED_DIRS = frozenset({"primary", "worker", "consensus", "network"})
+    _BROAD = {"Exception", "BaseException"}
+    _LOG_METHODS = {
+        "debug", "info", "warning", "warn", "error", "exception", "critical", "log",
+    }
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            body = [
+                s
+                for s in node.body
+                if not (
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and isinstance(s.value.value, str)
+                )
+            ]
+            handled = self._handles(body)
+            caught = self._caught_names(node)
+            if all(
+                isinstance(s, (ast.Pass, ast.Continue))
+                or (
+                    isinstance(s, ast.Expr)
+                    and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis
+                )
+                for s in body
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"except {caught or '<all>'} silently swallows the "
+                    "error — log it (logger.debug at minimum), re-raise, "
+                    "or suppress with a one-line justification",
+                )
+            elif (
+                not handled
+                and (node.type is None or self._BROAD.intersection(self._caught_set(node)))
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"broad `except {caught or ''}` without logging or "
+                    "re-raise: narrow the exception types, or log what was "
+                    "swallowed",
+                )
+
+    def _caught_set(self, node: ast.ExceptHandler) -> set[str]:
+        t = node.type
+        out: set[str] = set()
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, ast.Tuple):
+            for e in t.elts:
+                if isinstance(e, ast.Name):
+                    out.add(e.id)
+        return out
+
+    def _caught_names(self, node: ast.ExceptHandler) -> str:
+        if node.type is None:
+            return ""
+        return ast.unparse(node.type) if hasattr(ast, "unparse") else "..."
+
+    def _handles(self, body: list[ast.stmt]) -> bool:
+        """True if the handler visibly deals with the error: re-raises,
+        logs, or forwards it into a future."""
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    # Any logger-shaped method call counts (logger.warning,
+                    # self._log.error, logging.getLogger(...).exception).
+                    if isinstance(f, ast.Attribute) and f.attr in self._LOG_METHODS:
+                        return True
+                    # Forwarding the error into a future propagates it.
+                    if isinstance(f, ast.Attribute) and f.attr == "set_exception":
+                        return True
+                    if dotted(f) in ("warnings.warn", "traceback.print_exc"):
+                        return True
+        return False
