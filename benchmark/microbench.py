@@ -169,6 +169,154 @@ def bench_dag_service(
     return out
 
 
+def bench_storage_group_commit(concurrency: int = 64) -> list[dict]:
+    """Group-commit WAL vs the seed per-put flush: `concurrency` single-key
+    puts issued together, sync API (one WAL append + flush each, the seed
+    hot path) vs put_async (one fused record + one flush per group), at
+    BOTH durability levels — `buffered` (seed semantics: flush() to the OS,
+    process-crash durable) and `fsync` (machine-crash durable, the level
+    where the amortized syscall dominates). The ISSUE-4 acceptance gate:
+    the async path must be >=3x for 64 concurrent puts vs per-put flush."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from narwhal_tpu.storage import StorageEngine, StorageStats
+
+    tmp = tempfile.mkdtemp(prefix="narwhal-storage-bench-")
+
+    async def run_mode(mode: str, fsync: bool, budget: float) -> tuple[float, dict]:
+        eng = StorageEngine(
+            f"{tmp}/{mode}-{fsync}", use_native=False, fsync=fsync
+        )
+        cf = eng.column_family("bench")
+        value = b"\x5a" * 256
+        # warm
+        if mode == "sync":
+            for i in range(concurrency):
+                cf.put(b"w%d" % i, value)
+        else:
+            await asyncio.gather(
+                *(cf.put_async(b"w%d" % i, value) for i in range(concurrency))
+            )
+        before = StorageStats.snapshot()
+        n, t0 = 0, time.perf_counter()
+        while time.perf_counter() - t0 < budget:
+            if mode == "sync":
+                for i in range(concurrency):
+                    cf.put(b"k%d" % i, value)
+            else:
+                await asyncio.gather(
+                    *(
+                        cf.put_async(b"k%d" % i, value)
+                        for i in range(concurrency)
+                    )
+                )
+            n += concurrency
+        dt = time.perf_counter() - t0
+        after = StorageStats.snapshot()
+        eng.close()
+        stats = {
+            k: after[k] - before[k]
+            for k in ("groups_committed", "ops_committed")
+        }
+        return n / dt, stats
+
+    out = []
+    for fsync in (False, True):
+        level = "fsync" if fsync else "buffered"
+        rates = {}
+        for mode in ("sync", "group"):
+            budget = 1.0 if not fsync or mode == "group" else 3.0
+            rate, stats = asyncio.run(run_mode(mode, fsync, budget))
+            rates[mode] = rate
+            out.append(
+                {
+                    "metric": f"storage_puts_per_s[{mode},{level}]",
+                    "value": round(rate, 1),
+                    "unit": "puts/s",
+                    "concurrency": concurrency,
+                    **({"group_stats": stats} if mode == "group" else {}),
+                }
+            )
+        out.append(
+            {
+                "metric": f"storage_group_commit_speedup[{level}]",
+                "value": round(rates["group"] / rates["sync"], 2),
+                "unit": "x",
+                "concurrency": concurrency,
+            }
+        )
+    shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def bench_rpc_coalesce(k: int = 16) -> list[dict]:
+    """Coalesced RPC writes: k requests in flight on one loopback
+    connection (frames share socket flushes) vs k strictly sequential
+    requests (one write+drain round-trip each)."""
+    import asyncio
+
+    from narwhal_tpu.messages import SubmitTransactionMsg
+    from narwhal_tpu.network import NetworkClient
+    from narwhal_tpu.network.rpc import RpcServer, WireStats
+
+    async def run_bench() -> list[dict]:
+        server = RpcServer()
+
+        async def ack(msg, peer):
+            return None
+
+        server.route(SubmitTransactionMsg, ack)
+        port = await server.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{port}"
+        net = NetworkClient()
+        msg = SubmitTransactionMsg(b"\x42" * 64)
+        await net.unreliable_send(addr, msg)  # connect + warm
+
+        rows = []
+        rates = {}
+        for mode in ("sequential", "concurrent"):
+            before = WireStats.snapshot()
+            n, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < 1.0:
+                if mode == "sequential":
+                    for _ in range(k):
+                        await net.unreliable_send(addr, msg)
+                else:
+                    await asyncio.gather(
+                        *(net.unreliable_send(addr, msg) for _ in range(k))
+                    )
+                n += k
+            dt = time.perf_counter() - t0
+            after = WireStats.snapshot()
+            drains = after["drains"] - before["drains"]
+            frames = after["frames_sent"] - before["frames_sent"]
+            rates[mode] = n / dt
+            rows.append(
+                {
+                    "metric": f"rpc_requests_per_s[{mode}]",
+                    "value": round(n / dt, 1),
+                    "unit": "reqs/s",
+                    "in_flight": 1 if mode == "sequential" else k,
+                    "frames_per_drain": round(frames / drains, 2) if drains else None,
+                }
+            )
+        rows.append(
+            {
+                "metric": "rpc_coalesce_speedup",
+                "value": round(rates["concurrent"] / rates["sequential"], 2),
+                "unit": "x",
+                "in_flight": k,
+            }
+        )
+        net.close()
+        await server.stop()
+        return rows
+
+    return asyncio.run(run_bench())
+
+
 def _jax_backend() -> str:
     try:
         import jax
@@ -220,14 +368,22 @@ def main() -> None:
     ap.add_argument("--profile", action="store_true", help="cProfile the consensus bench")
     ap.add_argument("--dag-service", action="store_true",
                     help="also run the Dag-service read_causal cpu-vs-tpu bench")
+    ap.add_argument("--storage", action="store_true",
+                    help="run ONLY the storage group-commit vs per-put-flush bench")
+    ap.add_argument("--rpc-coalesce", action="store_true",
+                    help="run ONLY the coalesced-vs-sequential RPC write bench")
     ap.add_argument("--out", default=None,
                     help="also write the selected benches as a JSON array to this path")
     args = ap.parse_args()
     rows = []
-    if not args.dag_service:
-        rows += bench_batch_digest() + bench_codec() + bench_process_certificates()
-    else:
+    if args.storage:
+        rows += bench_storage_group_commit()
+    elif args.rpc_coalesce:
+        rows += bench_rpc_coalesce()
+    elif args.dag_service:
         rows += bench_dag_service()
+    else:
+        rows += bench_batch_digest() + bench_codec() + bench_process_certificates()
     for rec in rows:
         print(json.dumps(rec))
     if args.out:

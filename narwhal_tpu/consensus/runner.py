@@ -86,11 +86,20 @@ class Consensus:
                         logger.info("Committee updated to epoch %s", note.committee.epoch)
                     recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
                 if cert_task in done:
-                    certificate: Certificate = cert_task.result()
+                    certs: list[Certificate] = [cert_task.result()]
+                    # Greedy bounded drain: a burst of certificates from
+                    # the primary is ordered in one pass instead of one
+                    # select round-trip per certificate.
+                    while len(certs) < 64:
+                        extra = self.rx_new_certificates.try_recv()
+                        if extra is None:
+                            break
+                        certs.append(extra)
                     cert_task = asyncio.ensure_future(self.rx_new_certificates.recv())
-                    if certificate.epoch != self.committee.epoch:
-                        continue  # stale epoch, drop
-                    await self._process(certificate)
+                    for certificate in certs:
+                        if certificate.epoch != self.committee.epoch:
+                            continue  # stale epoch, drop
+                        await self._process(certificate)
         finally:
             recon_task.cancel()
             cert_task.cancel()

@@ -78,7 +78,11 @@ def decode_message(tag: int, body: bytes):
     msg = cls.decode(r)
     r.done()
     if len(body) <= _DECODE_MAX_BODY:
-        _DECODE_CACHE.put(key, msg, weight=len(body))
+        # The (tag, body) key tuple pins the raw body bytes alongside the
+        # decoded object (which aliases/copies roughly the same bytes), so
+        # one entry holds ~2x the body in memory; charge both sides against
+        # the byte budget or the cache runs ~2x over its nominal bound.
+        _DECODE_CACHE.put(key, msg, weight=2 * len(body))
     return msg
 
 

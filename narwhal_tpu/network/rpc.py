@@ -103,12 +103,25 @@ class WireStats:
     Two integer adds per frame — cheap enough to stay always-on; the
     benchmark harness samples `snapshot()` around its measurement window
     to report bytes-per-round (the metric the compact-certificate wire
-    form exists to move)."""
+    form exists to move) and frames-per-drain (the metric the write
+    coalescer exists to move)."""
 
     frames_sent = 0
     bytes_sent = 0
     frames_received = 0
     bytes_received = 0
+    # Write-coalescing accounting: one "drain" = one socket flush covering
+    # every frame queued on that connection at that moment.
+    drains = 0
+    frames_per_drain: dict[int, int] = {}  # power-of-two bucket -> drains
+
+    @classmethod
+    def record_drain(cls, frames: int) -> None:
+        cls.drains += 1
+        bucket = 1
+        while bucket < frames:
+            bucket <<= 1
+        cls.frames_per_drain[bucket] = cls.frames_per_drain.get(bucket, 0) + 1
 
     @classmethod
     def snapshot(cls) -> dict:
@@ -117,6 +130,8 @@ class WireStats:
             "bytes_sent": cls.bytes_sent,
             "frames_received": cls.frames_received,
             "bytes_received": cls.bytes_received,
+            "drains": cls.drains,
+            "frames_per_drain": dict(sorted(cls.frames_per_drain.items())),
         }
 
 
@@ -164,6 +179,74 @@ async def _read_frame(
     return kind, rid, tag, body
 
 
+class FrameSender:
+    """Per-connection write coalescer: frames enqueue synchronously; a
+    single drainer task packs EVERYTHING currently queued into one burst of
+    `writer.write` calls followed by ONE `drain()`. Nagle without the
+    delay — nothing ever waits for more traffic, but whatever is already
+    pending when the socket flushes shares that flush, so an N-frame burst
+    (a broadcast fan-in, a server's concurrent responses) costs one
+    syscall round-trip instead of N.
+
+    AEAD sealing happens at WRITE time in queue order, so the session's
+    counter-nonce sequence always matches the wire order (the invariant
+    `_write_frame` documents). Post-handshake, a connection's frames MUST
+    all go through its sender — a second writer would fork the nonce
+    sequence.
+
+    Queue depth is bounded by the callers: client requests are capped by
+    their own timeouts/retry handles, server responses by the per-
+    connection dispatch semaphore (MAX_TASK_CONCURRENCY)."""
+
+    __slots__ = ("_writer", "_session", "_on_error", "_queue", "_task", "_closed")
+
+    def __init__(
+        self,
+        writer: asyncio.StreamWriter,
+        session: Session | None = None,
+        on_error: Callable[[Exception], None] | None = None,
+    ):
+        self._writer = writer
+        self._session = session
+        self._on_error = on_error
+        self._queue: list[tuple[int, int, int, bytes]] = []
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    def send(self, kind: int, rid: int, tag: int, body: bytes) -> None:
+        """Enqueue one frame (never blocks). Raises RpcError if the
+        transport already failed."""
+        if self._closed:
+            raise RpcError("connection closed")
+        self._queue.append((kind, rid, tag, body))
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._drain_loop())
+
+    async def _drain_loop(self) -> None:
+        try:
+            while self._queue:
+                batch, self._queue = self._queue, []
+                for kind, rid, tag, body in batch:
+                    _write_frame(
+                        self._writer, kind, rid, tag, body, self._session
+                    )
+                WireStats.record_drain(len(batch))
+                # Frames enqueued while this drain awaits ride the next
+                # iteration — one flush each for whatever coalesced.
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._closed = True
+            self._queue.clear()
+            if self._on_error is not None:
+                self._on_error(e)
+
+    def close(self) -> None:
+        self._closed = True
+        self._queue.clear()
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+
+
 class PeerClient:
     """Persistent connection to one peer address with request/response
     correlation and lazy reconnect. With credentials + an expected key the
@@ -177,6 +260,7 @@ class PeerClient:
         self.address = address
         self._credentials = credentials
         self._writer: asyncio.StreamWriter | None = None
+        self._sender: FrameSender | None = None
         self._reader_task: asyncio.Task | None = None
         self._pending: dict[int, asyncio.Future] = {}
         self._rid = itertools.count(1)
@@ -212,6 +296,13 @@ class PeerClient:
                     raise RpcError(f"handshake with {self.address} failed: {e}") from e
             self._session = session
             self._writer = writer
+            self._sender = FrameSender(
+                writer,
+                session,
+                on_error=lambda e: self._teardown(
+                    RpcError(f"send to {self.address} failed: {e}")
+                ),
+            )
             self._reader_task = asyncio.ensure_future(self._read_loop(reader, session))
 
     async def _read_loop(
@@ -252,6 +343,9 @@ class PeerClient:
             self._teardown(RpcError(f"connection to {self.address} lost"))
 
     def _teardown(self, exc: Exception) -> None:
+        if self._sender is not None:
+            self._sender.close()
+        self._sender = None
         if self._writer is not None:
             try:
                 self._writer.close()
@@ -267,21 +361,29 @@ class PeerClient:
 
     async def request(self, msg, timeout: float | None = 10.0):
         """Send a request frame, await the peer's response (Ack for oneway
-        handlers). Raises RpcError/OSError on transport failure."""
-        if self._writer is None:
+        handlers). Raises RpcError/OSError on transport failure.
+
+        The frame goes through the connection's FrameSender: concurrent
+        requests on one link (a broadcast burst, QuorumWaiter fan-out)
+        share a single socket flush instead of awaiting one drain() each;
+        transport failures surface through the pending future (the sender's
+        on_error tears the connection down, failing every in-flight rid)."""
+        if self._sender is None:
             await self._connect()
         rid = next(self._rid)
         tag, body = encode_message(msg)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         try:
-            _write_frame(self._writer, KIND_REQ, rid, tag, body, self._session)
-            await self._writer.drain()
+            self._sender.send(KIND_REQ, rid, tag, body)
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError) as e:
             self._pending.pop(rid, None)
             self._teardown(RpcError(str(e)))
             raise RpcError(f"send to {self.address} failed: {e}") from e
+        except RpcError:
+            self._pending.pop(rid, None)
+            raise
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)
             raise RpcTimeout(f"request to {self.address} timed out")
@@ -395,6 +497,7 @@ class RpcServer:
         sem = asyncio.Semaphore(self._max_concurrency)
         tasks: set[asyncio.Task] = set()
         session: Session | None = None
+        sender: FrameSender | None = None
         try:
             if self._auth_keypair is not None:
                 try:
@@ -404,13 +507,16 @@ class RpcServer:
                 except (AuthError, asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
                     logger.debug("Rejected unauthenticated peer %s: %s", peer_addr, e)
                     return
+            # Responses coalesce per connection: concurrent handlers that
+            # complete in the same window share one socket flush.
+            sender = FrameSender(writer, session)
             while True:
                 kind, rid, tag, body = await _read_frame(reader, session)
                 if kind != KIND_REQ:
                     continue
                 await sem.acquire()
                 t = asyncio.ensure_future(
-                    self._dispatch(writer, rid, tag, body, peer, session)
+                    self._dispatch(sender, rid, tag, body, peer)
                 )
                 tasks.add(t)
                 t.add_done_callback(lambda t_: (tasks.discard(t_), sem.release()))
@@ -418,6 +524,8 @@ class RpcServer:
             logger.debug("peer %s disconnected: %r", peer_addr, e)
         finally:
             self._writers.discard(writer)
+            if sender is not None:
+                sender.close()
             for t in tasks:
                 t.cancel()
             try:
@@ -427,12 +535,11 @@ class RpcServer:
 
     async def _dispatch(
         self,
-        writer: asyncio.StreamWriter,
+        sender: FrameSender,
         rid: int,
         tag: int,
         body: bytes,
         peer: Peer,
-        session: Session | None = None,
     ) -> None:
         try:
             entry = self._handlers.get(tag)
@@ -456,9 +563,8 @@ class RpcServer:
             logger.debug("handler for tag %d raised: %r", tag, e)
             out = (KIND_ERR, rid, 0, str(e).encode())
         try:
-            _write_frame(writer, *out, session)
-            await writer.drain()
-        except (ConnectionError, OSError) as e:
+            sender.send(*out)
+        except RpcError as e:
             logger.debug("response to %s dropped (peer gone): %r", peer.addr, e)
 
     async def stop(self) -> None:

@@ -92,6 +92,8 @@ class PrimaryNode:
         self.storage = storage
         self.registry = registry or Registry()
         self.internal_consensus = internal_consensus
+        # Group-commit instruments (fused-WAL group size / flush latency).
+        storage.engine.attach_metrics(self.registry)
 
         # Channels between the three subsystems (node/src/lib.rs:150-192),
         # depth-gauged like the reference's porcelain metrics (lib.rs:168-192).

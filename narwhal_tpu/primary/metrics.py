@@ -50,3 +50,9 @@ class PrimaryMetrics:
         self.votes_sent = registry.counter(
             "primary_votes_sent", "Votes sent to header authors"
         )
+        self.core_burst = registry.histogram(
+            "primary_core_burst_size",
+            "messages the core drained per select iteration (greedy "
+            "bounded burst; >1 means one grouped commit served several)",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )

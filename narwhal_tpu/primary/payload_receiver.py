@@ -15,6 +15,8 @@ from ..stores import PayloadStore
 
 
 class PayloadReceiver:
+    MAX_BURST = 256
+
     def __init__(self, payload_store: PayloadStore, rx_workers: Channel):
         self.payload_store = payload_store
         self.rx_workers = rx_workers
@@ -26,5 +28,13 @@ class PayloadReceiver:
 
     async def run(self) -> None:
         while True:
-            digest, worker_id = await self.rx_workers.recv()
-            self.payload_store.write(digest, worker_id)
+            pairs = [await self.rx_workers.recv()]
+            # Greedy bounded drain: a burst of worker reports becomes one
+            # grouped availability commit (availability tokens are visible
+            # via the memtable immediately; one fused flush covers all).
+            while len(pairs) < self.MAX_BURST:
+                extra = self.rx_workers.try_recv()
+                if extra is None:
+                    break
+                pairs.append(extra)
+            await self.payload_store.write_all_async(pairs)

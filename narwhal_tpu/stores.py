@@ -52,21 +52,28 @@ class CertificateStore:
     def _round_key(round: Round, origin: PublicKey, digest: Digest) -> bytes:
         return _RK.pack(round) + origin + digest
 
-    def write(self, cert: Certificate) -> None:
-        """Atomic main+index write (certificate_store.rs:55-90)."""
-        self._engine.write_batch(
-            [
-                (self._main, cert.digest, cert.to_bytes()),
-                (self._by_round, self._round_key(cert.round, cert.origin, cert.digest), b"\0"),
-            ]
-        )
-
-    def write_all(self, certs: Iterable[Certificate]) -> None:
+    def _puts(self, certs: Iterable[Certificate]) -> list:
         puts = []
         for c in certs:
             puts.append((self._main, c.digest, c.to_bytes()))
             puts.append((self._by_round, self._round_key(c.round, c.origin, c.digest), b"\0"))
-        self._engine.write_batch(puts)
+        return puts
+
+    def write(self, cert: Certificate) -> None:
+        """Atomic main+index write (certificate_store.rs:55-90)."""
+        self._engine.write_batch(self._puts([cert]))
+
+    def write_all(self, certs: Iterable[Certificate]) -> None:
+        self._engine.write_batch(self._puts(certs))
+
+    def write_async(self, cert: Certificate):
+        """Group-commit write: returns the shared commit future (the
+        memtable — and notify_read waiters — see the certificate without
+        awaiting it)."""
+        return self._engine.write_batch_async(self._puts([cert]))
+
+    def write_all_async(self, certs: Iterable[Certificate]):
+        return self._engine.write_batch_async(self._puts(certs))
 
     def read(self, digest: Digest) -> Certificate | None:
         raw = self._main.get(digest)
@@ -144,6 +151,9 @@ class HeaderStore:
     def write(self, header: Header) -> None:
         self._cf.put(header.digest, header.to_bytes())
 
+    def write_async(self, header: Header):
+        return self._cf.put_async(header.digest, header.to_bytes())
+
     def read(self, digest: Digest) -> Header | None:
         raw = self._cf.get(digest)
         if raw is None:
@@ -179,6 +189,15 @@ class PayloadStore:
 
     def write(self, digest: Digest, worker_id: WorkerId) -> None:
         self._cf.put(self._key(digest, worker_id), b"\1")
+
+    def write_async(self, digest: Digest, worker_id: WorkerId):
+        return self._cf.put_async(self._key(digest, worker_id), b"\1")
+
+    def write_all_async(self, pairs: Iterable[tuple[Digest, WorkerId]]):
+        """One grouped availability commit for a burst of worker reports."""
+        return self._cf.put_all_async(
+            (self._key(d, w), b"\1") for d, w in pairs
+        )
 
     def contains(self, digest: Digest, worker_id: WorkerId) -> bool:
         return self._cf.contains(self._key(digest, worker_id))
@@ -224,6 +243,11 @@ class VoteDigestStore:
 
     def write(self, origin: PublicKey, round: Round, header_digest: Digest) -> None:
         self._cf.put(origin, struct.pack("<Q", round) + header_digest)
+
+    def write_async(self, origin: PublicKey, round: Round, header_digest: Digest):
+        return self._cf.put_async(
+            origin, struct.pack("<Q", round) + header_digest
+        )
 
     def read(self, origin: PublicKey) -> tuple[Round, Digest] | None:
         raw = self._cf.get(origin)

@@ -54,6 +54,49 @@ def _next_pow2(n: int) -> int:
     return b
 
 
+# Sharded-kernel jit wrappers shared across TpuVerifier instances keyed by
+# the mesh geometry. Each `jax.jit(...)` call owns its OWN trace/compile
+# cache, so two verifiers over the same mesh (e.g. the dryrun's item-mode
+# and msm-mode legs: the msm verifier re-jits the per-item kernel for its
+# fallback path) would otherwise each pay the multi-minute
+# jit_verify_batch_kernel compile — the MULTICHIP_r05 rc=124 bill.
+_SHARDED_KERNELS: dict = {}
+
+
+def _sharded_kernels(kernel, mesh, data_axis: str):
+    key = (
+        tuple(mesh.devices.flat),
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        data_axis,
+    )
+    cached = _SHARDED_KERNELS.get(key)
+    if cached is not None:
+        return cached
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def s(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    b1, b2 = s(data_axis), s(data_axis, None)
+    item_kernel = jax.jit(
+        kernel.verify_batch_kernel.__wrapped__,
+        in_shardings=(b2, b1, b2, b1, b2, b2),
+        out_shardings=(b1, b1),
+    )
+    msm_kernel = jax.jit(
+        kernel.msm_accumulate_kernel.__wrapped__,
+        static_argnames=("chunk",),
+        in_shardings=(b2, b1, b2, b1, b2, b2),
+        # V_a/V_r replicated (cross-device reduced), valid sharded.
+        out_shardings=(s(), s(), b1),
+    )
+    _SHARDED_KERNELS[key] = (item_kernel, msm_kernel)
+    return item_kernel, msm_kernel
+
+
 def msm_epilogue_check(
     va_limbs: np.ndarray, vr_limbs: np.ndarray, sum_s: int, kernel
 ) -> bool:
@@ -154,10 +197,6 @@ class TpuVerifier:
         # data-axis size.
         self.mesh = mesh
         if mesh is not None:
-            import jax
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-
             # Fail at CONSTRUCTION, not first dispatch: every bucket this
             # verifier can ever pad to is a power of two in
             # [_MIN_BUCKET, max_bucket] (or exactly max_bucket when
@@ -179,21 +218,11 @@ class TpuVerifier:
                     f"{self.max_bucket}); use a power of two <= {smallest}"
                 )
 
-            def s(*spec):
-                return NamedSharding(mesh, P(*spec))
-
-            b1, b2 = s(data_axis), s(data_axis, None)
-            self._item_kernel = jax.jit(
-                kernel.verify_batch_kernel.__wrapped__,
-                in_shardings=(b2, b1, b2, b1, b2, b2),
-                out_shardings=(b1, b1),
-            )
-            self._msm_kernel = jax.jit(
-                kernel.msm_accumulate_kernel.__wrapped__,
-                static_argnames=("chunk",),
-                in_shardings=(b2, b1, b2, b1, b2, b2),
-                # V_a/V_r replicated (cross-device reduced), valid sharded.
-                out_shardings=(s(), s(), b1),
+            # Shared per-mesh jit wrappers: every verifier over this mesh
+            # (either mode — msm keeps the item kernel as its fallback)
+            # reuses ONE compiled kernel pair instead of re-jitting.
+            self._item_kernel, self._msm_kernel = _sharded_kernels(
+                kernel, mesh, data_axis
             )
         else:
             self._item_kernel = kernel.verify_batch_kernel

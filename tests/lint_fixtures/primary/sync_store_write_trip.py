@@ -1,0 +1,16 @@
+"""Tripping fixture for no-sync-store-write-in-async: sync store writes
+inside async defs in a primary/-scoped module (4 findings)."""
+
+
+class Core:
+    async def process_header(self, header):
+        self.header_store.write(header)  # 1: typed-store write
+
+    async def record_payload(self, digest, worker_id):
+        self.payload_store.put(digest, worker_id)  # 2: store put
+
+    async def persist_batch(self, puts):
+        self._engine.write_batch(puts)  # 3: raw engine batch
+
+    async def persist_all(self, store, certs):
+        store.write_all(certs)  # 4: bare store-named receiver

@@ -153,6 +153,50 @@ def test_bounded_cache_rejects_over_budget_entry():
     assert cache.total_bytes <= 100
 
 
+def test_decode_cache_weight_accounts_key_bytes(monkeypatch):
+    """The decode cache's key tuple pins the raw body bytes next to the
+    decoded object, so an entry must be charged ~2x the body length: a
+    budget of 2*len(body)-1 refuses the entry, 2*len(body) admits it."""
+    fx = CommitteeFixture(size=4)
+    tag, body = encode_message(HeaderMsg(fx.header(author=0, round=7)))
+    body = bytes(body)
+
+    monkeypatch.setattr(
+        messages, "_DECODE_CACHE", BoundedCache(max_bytes=2 * len(body) - 1)
+    )
+    a = decode_message(tag, body)
+    b = decode_message(tag, body)
+    assert a is not b  # over budget even when empty: never admitted
+    assert messages._DECODE_CACHE.total_bytes == 0
+
+    monkeypatch.setattr(
+        messages, "_DECODE_CACHE", BoundedCache(max_bytes=2 * len(body))
+    )
+    a = decode_message(tag, body)
+    b = decode_message(tag, body)
+    assert a is b
+    assert messages._DECODE_CACHE.total_bytes == 2 * len(body)
+
+
+def test_bounded_cache_byte_accounting_stays_exact():
+    """total_bytes must equal the sum of live entries' weights through
+    admissions, evictions, and rejections — a drifting byte ledger either
+    leaks budget (cache shrinks to nothing) or overfills memory."""
+    cache = BoundedCache(max_bytes=100)
+    weights = {}
+    for i in range(50):
+        w = (i % 7) * 5 + 5  # 5..35
+        cache.put(i, i, weight=w)
+        weights[i] = w
+    live = {k: w for k, w in weights.items() if k in cache}
+    assert cache.total_bytes == sum(live.values())
+    assert cache.total_bytes <= 100
+    # A rejected over-budget entry must not disturb the ledger.
+    before = cache.total_bytes
+    cache.put("huge", 0, weight=101)
+    assert "huge" not in cache and cache.total_bytes == before
+
+
 def test_bounded_cache_concurrent_eviction_thread_safety():
     """The r5-review crash scenario: verify() runs on executor threads;
     concurrent evictions over a plain dict double-delete keys. The shared

@@ -23,6 +23,25 @@ def _unary(channel, service, method, reply_cls):
     )
 
 
+async def _probe(call, request, timeout=30.0):
+    """Issue a gRPC call whose *definitive* outcome (a response OR a real
+    status) is under test, retrying only the transient under-load states —
+    UNAVAILABLE (server/loop busy or still starting) — to a deadline, the
+    `_wait_rounds` deflake pattern. Returns the response, or raises the
+    first non-transient AioRpcError for the caller to assert on."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        try:
+            return await call(request)
+        except grpc.aio.AioRpcError as e:
+            if (
+                e.code() != grpc.StatusCode.UNAVAILABLE
+                or asyncio.get_event_loop().time() > deadline
+            ):
+                raise
+        await asyncio.sleep(0.2)
+
+
 async def _wait_rounds(rounds_call, pk, minimum, timeout=30.0):
     """Poll Rounds until `minimum` is reached. NOT_FOUND is the expected
     not-yet state (the Dag serves OutOfCertificates until the first
@@ -187,15 +206,24 @@ def test_grpc_error_paths(run):
             new_epoch = _unary(channel, "Configuration", "NewEpoch", pb.Empty)
             await _wait_rounds(rounds, node.name, 2)
 
+            # Every probe below asserts a DEFINITIVE outcome (a payload or
+            # a specific status); under full-suite load any of them can
+            # transiently see UNAVAILABLE first, so each goes through
+            # `_probe` — the same deadline-retry deflake `_wait_rounds`
+            # uses (VERDICT r5: this test failed reproducibly in-suite,
+            # passing isolated).
+
             # Unknown digest: per-collection error in the response.
-            resp = await get(pb.CollectionRequest(collection_ids=[b"\xee" * 32]))
+            resp = await _probe(
+                get, pb.CollectionRequest(collection_ids=[b"\xee" * 32])
+            )
             assert len(resp.results) == 1
             assert resp.results[0].error != ""  # explicit per-item error
 
             # Malformed (short) digest: clean error, service stays up.
             try:
-                resp_short = await get(
-                    pb.CollectionRequest(collection_ids=[b"short"])
+                resp_short = await _probe(
+                    get, pb.CollectionRequest(collection_ids=[b"short"])
                 )
                 # Non-aborting servers must still flag the item as an error.
                 assert resp_short.results[0].error != ""
@@ -206,7 +234,7 @@ def test_grpc_error_paths(run):
                 )
             # Unknown validator key.
             try:
-                await rounds(pb.RoundsRequest(public_key=b"\x00" * 32))
+                await _probe(rounds, pb.RoundsRequest(public_key=b"\x00" * 32))
                 raise AssertionError("unknown validator must error")
             except grpc.aio.AioRpcError as e:
                 assert e.code() in (
@@ -217,13 +245,15 @@ def test_grpc_error_paths(run):
 
             # NewEpoch: reference parity — UNIMPLEMENTED.
             try:
-                await new_epoch(pb.NewEpochRequest(epoch_number=1))
+                await _probe(new_epoch, pb.NewEpochRequest(epoch_number=1))
                 raise AssertionError("NewEpoch must be unimplemented")
             except grpc.aio.AioRpcError as e:
                 assert e.code() == grpc.StatusCode.UNIMPLEMENTED
 
-            # Still alive.
-            resp = await rounds(pb.RoundsRequest(public_key=node.name))
+            # Still alive: rounds must remain servable (NOT_FOUND here
+            # would be a post-probe regression, so only UNAVAILABLE — the
+            # transient under-load state — retries via _probe).
+            resp = await _probe(rounds, pb.RoundsRequest(public_key=node.name))
             assert resp.newest_round >= 2
         finally:
             if channel is not None:

@@ -80,6 +80,7 @@ EXPECTED_RULES = {
     "jit-purity",
     "no-shared-decode-mutation",
     "no-silent-except",
+    "no-sync-store-write-in-async",
 }
 
 FIXTURE_FOR = {
@@ -94,6 +95,10 @@ FIXTURE_FOR = {
     "no-silent-except": (
         "primary/silent_except_trip.py",
         "primary/silent_except_clean.py",
+    ),
+    "no-sync-store-write-in-async": (
+        "primary/sync_store_write_trip.py",
+        "primary/sync_store_write_clean.py",
     ),
 }
 
@@ -132,6 +137,7 @@ def test_fixture_finding_counts():
         "jit-purity": 4,  # print, time.time, global mutation, random under jit
         "no-shared-decode-mutation": 4,  # field, nested container, mutator, direct
         "no-silent-except": 2,  # pass-only swallow, broad unlogged catch
+        "no-sync-store-write-in-async": 4,  # store write/put, engine batch, bare store
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -591,6 +591,72 @@ class NoSharedDecodeMutation(Rule):
 
 
 # ---------------------------------------------------------------------------
+# no-sync-store-write-in-async
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoSyncStoreWriteInAsync(Rule):
+    name = "no-sync-store-write-in-async"
+    summary = (
+        "in primary/ and consensus/, async def bodies must use the "
+        "group-commit store API (put_async/write_async/write_batch_async): "
+        "a sync put/write runs its own WAL append + flush() on the event "
+        "loop, paying per-message I/O the batching layer exists to remove"
+    )
+
+    _SCOPED_DIRS = frozenset({"primary", "consensus"})
+    _WRITE_METHODS = {
+        "put",
+        "put_all",
+        "write",
+        "write_all",
+        "write_batch",
+        "write_consensus_state",
+    }
+    # Receiver-name heuristics for store-shaped objects: the typed stores
+    # (x.header_store, certificate_store, ...), the engine, and the raw
+    # column-family handles. Plain `writer.write(...)` (StreamWriter) and
+    # non-store receivers never match.
+    _STORE_SEGMENTS = frozenset(
+        {"engine", "_engine", "_cf", "_main", "_by_round", "_last", "_seq"}
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        for func in ast.walk(mod.tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for node in own_nodes(func):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._WRITE_METHODS
+                ):
+                    continue
+                recv = dotted(node.func.value)
+                if recv is None:
+                    continue
+                segments = recv.split(".")
+                if not any(
+                    "store" in seg.lower() or seg in self._STORE_SEGMENTS
+                    for seg in segments
+                ):
+                    continue
+                yield self.finding(
+                    mod,
+                    node,
+                    f"sync store write `{recv}.{node.func.attr}(...)` "
+                    f"inside `async def {func.name}`: each call is its own "
+                    "WAL append + flush() on the event loop — use the "
+                    f"async variant (`{node.func.attr}_async`/"
+                    "`write_batch_async`) so the write rides a fused "
+                    "group commit",
+                )
+
+
+# ---------------------------------------------------------------------------
 # no-silent-except
 # ---------------------------------------------------------------------------
 
