@@ -317,6 +317,161 @@ def bench_rpc_coalesce(k: int = 16) -> list[dict]:
     return asyncio.run(run_bench())
 
 
+def bench_commit_path(
+    batches_per_cert=(4, 16, 64), txs_per_batch=32, tx_bytes=128
+) -> list[dict]:
+    """Commit-to-execution payload staging, the three planes side by side:
+
+    * per-batch   — the seed data plane: one RequestBatchMsg RPC per batch
+                    digest (concurrently gathered, but still RPCs = batches);
+    * coalesced   — one RequestBatchesMsg per (worker, certificate) group
+                    through the real Subscriber staging path (RPCs = 1);
+    * prefetch-warm — the Prefetcher already staged the payload at
+                    certificate-acceptance time; commit staging is a pure
+                    local store read (RPCs = 0).
+
+    Reports ms/certificate and fetch RPCs per certificate for each mode —
+    the ISSUE-5 acceptance gate is >=8x fewer RPCs per committed certificate
+    for coalesced vs per-batch at 16 batches/cert."""
+    import asyncio
+
+    from narwhal_tpu.channels import Channel
+    from narwhal_tpu.executor.prefetcher import Prefetcher
+    from narwhal_tpu.executor.subscriber import Subscriber
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.messages import (
+        RequestBatchesMsg,
+        RequestBatchMsg,
+        RequestedBatchesMsg,
+        RequestedBatchMsg,
+    )
+    from narwhal_tpu.network import NetworkClient, RpcServer
+    from narwhal_tpu.stores import NodeStorage
+    from narwhal_tpu.types import Batch, ConsensusOutput
+
+    async def run_point(n_batches: int) -> list[dict]:
+        f = CommitteeFixture(size=4)
+        batches = [
+            Batch(
+                tuple(
+                    (b"%d-%d-" % (i, j)).ljust(tx_bytes, b"\x5a")
+                    for j in range(txs_per_batch)
+                )
+            )
+            for i in range(n_batches)
+        ]
+        by_digest = {b.digest: b.to_bytes() for b in batches}
+        digests = list(by_digest)
+        calls = {"rpcs": 0}
+        srv = RpcServer()
+
+        async def on_one(msg: RequestBatchMsg, peer):
+            calls["rpcs"] += 1
+            return RequestedBatchMsg(msg.digest, by_digest[msg.digest])
+
+        async def on_many(msg: RequestBatchesMsg, peer):
+            calls["rpcs"] += 1
+            return RequestedBatchesMsg(
+                tuple((d, True, by_digest[d]) for d in msg.digests)
+            )
+
+        srv.route(RequestBatchMsg, on_one)
+        srv.route(RequestBatchesMsg, on_many)
+        port = await srv.start("127.0.0.1", 0)
+        from narwhal_tpu.config import WorkerInfo
+
+        pk = f.authorities[0].public
+        info = f.worker_cache.workers[pk][0]
+        f.worker_cache.workers[pk][0] = WorkerInfo(
+            name=info.name,
+            transactions=info.transactions,
+            worker_address=f"127.0.0.1:{port}",
+        )
+        storage = NodeStorage(None)
+        temp = storage.temp_batch_store
+        net = NetworkClient()
+        sub = Subscriber(
+            pk, f.worker_cache, net, temp,
+            rx_consensus=Channel(10), tx_executor=Channel(10),
+        )
+        cert = f.certificate(
+            f.header(author=0, round=1, payload={d: 0 for d in digests})
+        )
+        output = ConsensusOutput(certificate=cert, consensus_index=0)
+
+        async def per_batch_stage():
+            """The seed plane: one RPC per digest, gathered."""
+            resps = await asyncio.gather(
+                *(
+                    net.request(f"127.0.0.1:{port}", RequestBatchMsg(d))
+                    for d in digests
+                )
+            )
+            return {r.digest: Batch.from_bytes(r.serialized_batch) for r in resps}
+
+        async def coalesced_stage():
+            _, staged, _t = await sub._stage(output, 0.0)
+            temp.delete_all(digests)  # the core's per-certificate cleanup
+            return staged
+
+        async def warm_stage():
+            _, staged, _t = await sub._stage(output, 0.0)
+            return staged  # leave the store warm: every commit is a hit
+
+        rows = []
+        results = {}
+        for mode, fn in (
+            ("per-batch", per_batch_stage),
+            ("coalesced", coalesced_stage),
+            ("prefetch-warm", warm_stage),
+        ):
+            if mode == "prefetch-warm":
+                # Warm exactly as production does: the prefetcher stages the
+                # accepted certificate's payload ahead of the commit.
+                pf = Prefetcher(
+                    pk, f.worker_cache, net, temp, rx_accepted=Channel(10)
+                )
+                await pf._prefetch_burst([cert])
+            staged = await fn()  # warm connections/compile nothing
+            assert set(staged) == set(digests)
+            rpcs0 = calls["rpcs"]
+            n, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < 0.5:
+                await fn()
+                n += 1
+            dt = (time.perf_counter() - t0) / n
+            rpcs_per_cert = (calls["rpcs"] - rpcs0) / n
+            results[mode] = rpcs_per_cert
+            rows.append(
+                {
+                    "metric": f"commit_path_ms_per_cert[{mode}]",
+                    "value": round(dt * 1000, 3),
+                    "unit": "ms/cert",
+                    "batches_per_cert": n_batches,
+                    "txs_per_batch": txs_per_batch,
+                    "rpcs_per_certificate": round(rpcs_per_cert, 2),
+                }
+            )
+        rows.append(
+            {
+                "metric": "commit_path_rpc_reduction[coalesced_vs_per_batch]",
+                "value": round(
+                    results["per-batch"] / max(results["coalesced"], 1e-9), 2
+                ),
+                "unit": "x",
+                "batches_per_cert": n_batches,
+            }
+        )
+        net.close()
+        await srv.stop()
+        return rows
+
+    out = []
+    for n_batches in batches_per_cert:
+        out.extend(asyncio.run(run_point(n_batches)))
+    return out
+
+
 def _jax_backend() -> str:
     try:
         import jax
@@ -372,6 +527,9 @@ def main() -> None:
                     help="run ONLY the storage group-commit vs per-put-flush bench")
     ap.add_argument("--rpc-coalesce", action="store_true",
                     help="run ONLY the coalesced-vs-sequential RPC write bench")
+    ap.add_argument("--commit-path", action="store_true",
+                    help="run ONLY the commit->execution staging bench "
+                         "(per-batch vs coalesced vs prefetch-warm)")
     ap.add_argument("--out", default=None,
                     help="also write the selected benches as a JSON array to this path")
     args = ap.parse_args()
@@ -380,6 +538,8 @@ def main() -> None:
         rows += bench_storage_group_commit()
     elif args.rpc_coalesce:
         rows += bench_rpc_coalesce()
+    elif args.commit_path:
+        rows += bench_commit_path()
     elif args.dag_service:
         rows += bench_dag_service()
     else:

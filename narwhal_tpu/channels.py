@@ -54,6 +54,23 @@ class Channel(Generic[T]):
             self._gauge.set(self._q.qsize())
         return True
 
+    async def send_many(self, items) -> None:
+        """Enqueue a burst with at most one suspension point per full queue:
+        items slot in via put_nowait while capacity lasts and only block
+        when the queue is actually full, and the depth gauge updates once
+        per burst instead of once per item. The executor's batch drain uses
+        this so applying a staged batch costs zero per-transaction channel
+        hops."""
+        for item in items:
+            try:
+                self._q.put_nowait(item)
+            except asyncio.QueueFull:
+                if self._gauge:
+                    self._gauge.set(self._q.qsize())
+                await self._q.put(item)
+        if self._gauge:
+            self._gauge.set(self._q.qsize())
+
     async def recv(self) -> T:
         item = await self._q.get()
         if self._gauge:

@@ -75,6 +75,11 @@ class Parameters:
     #             compact committees should run --crypto-backend tpu.
     #             Acceptance is inherently the cofactored rule.
     cert_format: str = "full"
+    # Byte budget for the executor's speculative payload prefetcher
+    # (executor/prefetcher.py): unclaimed pre-commit payload held in the
+    # temp batch store never exceeds this; 0 disables prefetching entirely.
+    # Env override: NARWHAL_PREFETCH_BUDGET (bytes, read at node assembly).
+    prefetch_budget: int = 64 << 20
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)

@@ -38,3 +38,12 @@ class ConsensusMetrics:
             "consensus_dag_read_coalesced_batch_size",
             "Requests served by the most recent fused device read_causal dispatch",
         )
+        # Accepted-certificate tap feeding the executor's speculative
+        # payload prefetcher (runner.py): the tap is strictly non-blocking,
+        # so drops here mean the prefetcher is falling behind acceptance —
+        # commits then pay their payload RTT at stage time again.
+        self.accepted_tap_dropped = registry.counter(
+            "consensus_accepted_tap_dropped",
+            "Accepted certificates dropped from the full prefetch tap "
+            "channel (speculation hint lost, never blocks ordering)",
+        )

@@ -35,6 +35,7 @@ class Consensus:
         rx_reconfigure: Watch,
         gc_depth: Round,
         metrics=None,
+        tx_accepted: Channel | None = None,  # non-blocking tap -> Prefetcher
     ):
         self.committee = committee
         self.protocol = protocol
@@ -46,6 +47,7 @@ class Consensus:
         self.rx_reconfigure = Subscriber(rx_reconfigure)
         self.gc_depth = gc_depth
         self.metrics = metrics
+        self.tx_accepted = tx_accepted
         self.consensus_index = consensus_store.last_consensus_index()
         self.state = ConsensusState.new_from_store(
             Certificate.genesis(committee),
@@ -99,6 +101,18 @@ class Consensus:
                     for certificate in certs:
                         if certificate.epoch != self.committee.epoch:
                             continue  # stale epoch, drop
+                        if self.tx_accepted is not None:
+                            # Speculative prefetch tap: batch digests are
+                            # known NOW, rounds before this certificate can
+                            # commit. Strictly non-blocking — speculation
+                            # must never backpressure ordering, so a full
+                            # channel just drops the hint (the commit-time
+                            # fetch covers it).
+                            if (
+                                not self.tx_accepted.try_send(certificate)
+                                and self.metrics is not None
+                            ):
+                                self.metrics.accepted_tap_dropped.inc()
                         await self._process(certificate)
         finally:
             recon_task.cancel()

@@ -22,6 +22,7 @@ from .core import (
     ExecutionStateError,
     ExecutorCore,
 )
+from .prefetcher import Prefetcher
 from .state import ExecutionIndices
 from .subscriber import Subscriber
 
@@ -32,6 +33,7 @@ __all__ = [
     "ExecutionStateError",
     "Executor",
     "ExecutorCore",
+    "Prefetcher",
     "Subscriber",
     "get_restored_consensus_output",
 ]
@@ -68,9 +70,28 @@ class Executor:
         rx_consensus: Channel,
         tx_output: Channel | None = None,
         registry=None,
+        rx_accepted: Channel | None = None,  # accepted-certificate tap
+        gc_depth: int = 50,
+        prefetch_budget: int | None = None,  # bytes; 0/None w/o tap disables
     ):
         metrics = ExecutorMetrics(registry) if registry is not None else None
         self.tx_executor = Channel(1_000)
+        self.prefetcher: Prefetcher | None = None
+        if rx_accepted is not None and (prefetch_budget is None or prefetch_budget > 0):
+            self.prefetcher = Prefetcher(
+                name,
+                worker_cache,
+                network,
+                storage.temp_batch_store,
+                rx_accepted,
+                gc_depth=gc_depth,
+                **(
+                    {"budget_bytes": prefetch_budget}
+                    if prefetch_budget is not None
+                    else {}
+                ),
+                metrics=metrics,
+            )
         self.subscriber = Subscriber(
             name,
             worker_cache,
@@ -78,6 +99,8 @@ class Executor:
             storage.temp_batch_store,
             rx_consensus,
             self.tx_executor,
+            metrics=metrics,
+            prefetcher=self.prefetcher,
         )
         self.core = ExecutorCore(
             execution_state,
@@ -92,6 +115,8 @@ class Executor:
         self, restored: list[ConsensusOutput] | None = None
     ) -> list[asyncio.Task]:
         self._tasks = [self.subscriber.spawn(), self.core.spawn()]
+        if self.prefetcher is not None:
+            self._tasks.append(self.prefetcher.spawn())
         # Re-inject restored outputs ahead of live traffic (lib.rs:120-135).
         for output in restored or []:
             await self.subscriber.rx_consensus.send(output)

@@ -399,6 +399,25 @@ class RequestBatchMsg:
         return RequestBatchMsg(_dec_digest(r))
 
 
+@message(25)
+@dataclass
+class RequestBatchesMsg:
+    """Coalesced batch fetch: every digest a requester is missing from ONE
+    worker rides a single RPC instead of one round trip each. The worker
+    answers from one coalesced store read with RequestedBatchesMsg, so the
+    commit-to-execution data plane pays RTT per (worker, certificate) group
+    rather than per batch. Digests answer in request order."""
+
+    digests: tuple[Digest, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.digests, _enc_digest)
+
+    @staticmethod
+    def decode(r: Reader) -> "RequestBatchesMsg":
+        return RequestBatchesMsg(tuple(r.seq(_dec_digest)))
+
+
 @message(23)
 @dataclass
 class DeleteBatchesMsg:
@@ -495,6 +514,36 @@ class RequestedBatchMsg:
         if not self.found:
             return ()
         return Batch.from_bytes(self.serialized_batch).transactions
+
+
+@message(35)
+@dataclass
+class RequestedBatchesMsg:
+    """Response to RequestBatchesMsg: one (digest, found, serialized_batch)
+    entry per requested digest, in request order, each byte-identical to what
+    the equivalent single RequestBatchMsg would have returned (misses carry
+    found=False and empty bytes). The server never decodes the stored wire
+    bytes; verification (serialized_batch_digest) is the requester's."""
+
+    batches: tuple[tuple[Digest, bool, bytes], ...]
+
+    def encode(self, w: Writer) -> None:
+        def enc(w_: Writer, item) -> None:
+            digest, found, raw = item
+            w_.raw(digest)
+            w_.u8(1 if found else 0)
+            w_.bytes(raw)
+
+        w.seq(self.batches, enc)
+
+    @staticmethod
+    def decode(r: Reader) -> "RequestedBatchesMsg":
+        def dec(r_: Reader):
+            digest = _dec_digest(r_)
+            found = r_.u8() == 1
+            return (digest, found, r_.bytes())
+
+        return RequestedBatchesMsg(tuple(r.seq(dec)))
 
 
 @message(33)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from .channels import Channel, drain_cancelled, metered_channel
 from .config import Committee, ConfigError, Parameters, WorkerCache
@@ -104,6 +105,22 @@ class PrimaryNode:
         self.tx_committed_certificates = chan("committed_certificates", 10_000)
         self.tx_consensus_output = chan("consensus_output", 10_000)
         self.tx_execution_output = chan("execution_output", 10_000)
+        # Accepted-certificate tap -> speculative payload prefetcher: batch
+        # digests are known at DAG acceptance, rounds before commit, so the
+        # executor can warm its temp batch store off the critical path.
+        # NARWHAL_PREFETCH_BUDGET (bytes) overrides the committee file;
+        # budget 0 disables the prefetcher and the tap entirely.
+        prefetch_budget = int(
+            os.environ.get(
+                "NARWHAL_PREFETCH_BUDGET",
+                getattr(parameters, "prefetch_budget", 64 << 20),
+            )
+        )
+        self.tx_accepted_certificates = (
+            chan("accepted_certificates", 10_000)
+            if internal_consensus and prefetch_budget > 0
+            else None
+        )
 
         # Crypto backend (the --crypto-backend flag of SURVEY §7.8c):
         #   cpu  — inline host verification in the Core (reference behavior)
@@ -279,6 +296,7 @@ class PrimaryNode:
                 self.primary.tx_reconfigure,
                 parameters.gc_depth,
                 ConsensusMetrics(self.registry),
+                tx_accepted=self.tx_accepted_certificates,
             )
             self.executor = Executor(
                 self.name,
@@ -289,6 +307,9 @@ class PrimaryNode:
                 self.tx_consensus_output,
                 self.tx_execution_output,
                 registry=self.registry,
+                rx_accepted=self.tx_accepted_certificates,
+                gc_depth=parameters.gc_depth,
+                prefetch_budget=prefetch_budget,
             )
         else:
             # External consensus: the Dag service consumes the certificate

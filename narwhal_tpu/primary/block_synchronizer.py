@@ -327,13 +327,20 @@ class BlockSynchronizer:
             for batch_digest, worker_id in cert.header.payload.items():
                 if not self.payload_store.contains(batch_digest, worker_id):
                     by_worker[worker_id][target].append(batch_digest)
-        for worker_id, per_target in by_worker.items():
-            info = self.worker_cache.worker(self.name, worker_id)
-            for target, batch_digests in per_target.items():
-                await self.network.unreliable_send(
-                    info.worker_address,
-                    SynchronizeMsg(tuple(batch_digests), target),
-                )
+        # One coalesced Synchronize per (worker, target) group, all groups
+        # fanned out concurrently — never one awaited RTT per group.
+        sends = [
+            (
+                self.worker_cache.worker(self.name, worker_id).worker_address,
+                SynchronizeMsg(tuple(batch_digests), target),
+            )
+            for worker_id, per_target in by_worker.items()
+            for target, batch_digests in per_target.items()
+        ]
+        if sends:
+            await asyncio.gather(
+                *(self.network.unreliable_send(a, m) for a, m in sends)
+            )
 
     # -- range catch-up ---------------------------------------------------
 

@@ -2,10 +2,14 @@
 own workers.
 
 Reference: /root/reference/primary/src/block_waiter.rs:45-845 — GetBlock /
-GetBlocks commands resolve a certificate digest to its batches by sending
-`RequestBatch` to the worker that holds each batch; concurrent requests for
-the same block are deduplicated; batch requests time out after 10s. Used by
-the executor's subscriber and the Validator gRPC API.
+GetBlocks commands resolve a certificate digest to its batches; concurrent
+requests for the same block are deduplicated; batch requests time out after
+10s. Used by the Validator gRPC API. Data-plane batching delta from the
+reference: a block's batch fetches group by target worker and each group
+rides ONE coalesced RequestBatchesMsg (one RPC, one coalesced store read on
+the worker) instead of one RequestBatch round trip per batch; partial
+responses map onto the same BlockError kinds (a deadline anywhere is
+BatchTimeout, an authoritative miss or transport failure is BatchError).
 """
 
 from __future__ import annotations
@@ -15,7 +19,7 @@ import logging
 from dataclasses import dataclass
 
 from ..config import WorkerCache
-from ..messages import RequestBatchMsg, RequestedBatchMsg
+from ..messages import RequestBatchesMsg, RequestedBatchesMsg
 from ..network import NetworkClient, RpcError
 from ..stores import CertificateStore
 from ..types import Batch, Certificate, Digest, PublicKey, serialized_batch_digest
@@ -108,25 +112,37 @@ class BlockWaiter:
         if certificate is None:
             raise BlockError(digest, "BlockNotFound")
         payload = list(certificate.header.payload.items())
-        # return_exceptions keeps sibling batch fetches from running on
-        # unobserved after the first failure; a timeout anywhere outranks
-        # transport errors in the reported kind (block_waiter.rs maps the
-        # per-batch deadline to BatchTimeout).
+        groups: dict[int, list[Digest]] = {}
+        for d, w in payload:
+            groups.setdefault(w, []).append(d)
+        # One coalesced fetch per target worker; return_exceptions keeps
+        # sibling worker fetches from running on unobserved after the first
+        # failure. A deadline anywhere outranks transport errors in the
+        # reported kind (block_waiter.rs maps the per-batch deadline to
+        # BatchTimeout).
         results = await asyncio.gather(
-            *(self._fetch_batch(d, w) for d, w in payload), return_exceptions=True
+            *(self._fetch_batches(w, ds) for w, ds in groups.items()),
+            return_exceptions=True,
         )
         if any(isinstance(r, _BatchTimeout) for r in results):
             raise BlockError(digest, "BatchTimeout")
+        fetched: dict[Digest, Batch] = {}
         for r in results:
             if isinstance(r, BaseException):
                 logger.debug("block %s batch error: %s", digest.hex()[:16], r)
                 raise BlockError(digest, "BatchError")
-        return BlockResponse(digest, list(zip((d for d, _ in payload), results)))
+            fetched.update(r)
+        return BlockResponse(digest, [(d, fetched[d]) for d, _ in payload])
 
-    async def _fetch_batch(self, batch_digest: Digest, worker_id: int) -> Batch:
-        """One batch from the worker that holds it, under the per-batch
-        deadline; transient transport failures retry a bounded number of
-        times so a restarting worker doesn't fail the block."""
+    async def _fetch_batches(
+        self, worker_id: int, digests: list[Digest]
+    ) -> dict[Digest, Batch]:
+        """Every batch one worker holds for this block, under the per-batch
+        deadline, as one RequestBatchesMsg round trip; transient transport
+        failures retry a bounded number of times so a restarting worker
+        doesn't fail the block. Partial responses are authoritative: a
+        found=False entry means the worker lacks the batch and retrying
+        won't help (BatchError), exactly the single-fetch semantics."""
         info = self.worker_cache.worker(self.name, worker_id)
         last: Exception | None = None
         # One deadline covers ALL attempts: retries are for fast transport
@@ -139,16 +155,17 @@ class BlockWaiter:
             if remaining <= 0:
                 break
             try:
-                resp: RequestedBatchMsg = await asyncio.wait_for(
+                resp: RequestedBatchesMsg = await asyncio.wait_for(
                     self.network.request(
-                        info.worker_address, RequestBatchMsg(batch_digest),
+                        info.worker_address, RequestBatchesMsg(tuple(digests)),
                         timeout=None,
                     ),
                     remaining,
                 )
             except asyncio.TimeoutError:
                 raise _BatchTimeout(
-                    f"worker {worker_id} batch {batch_digest.hex()[:16]} "
+                    f"worker {worker_id} batches "
+                    f"{[d.hex()[:16] for d in digests[:3]]} "
                     f"deadline ({self.batch_timeout}s)"
                 ) from None
             except (RpcError, OSError) as e:
@@ -159,18 +176,21 @@ class BlockWaiter:
                             max(0.0, deadline - loop.time()))
                     )
                 continue
-            if (
-                not resp.found
-                or serialized_batch_digest(resp.serialized_batch) != batch_digest
-            ):
-                # The worker answered authoritatively: retrying won't help.
-                raise RpcError(
-                    f"worker {worker_id} lacks batch {batch_digest.hex()[:16]}"
-                )
-            return Batch.from_bytes(resp.serialized_batch)
+            entries = {d: (found, raw) for d, found, raw in resp.batches}
+            out: dict[Digest, Batch] = {}
+            for d in digests:
+                found, raw = entries.get(d, (False, b""))
+                if not found or serialized_batch_digest(raw) != d:
+                    # The worker answered authoritatively: retrying won't
+                    # help (the reference's BatchError reply path).
+                    raise RpcError(
+                        f"worker {worker_id} lacks batch {d.hex()[:16]}"
+                    )
+                out[d] = Batch.from_bytes(raw)
+            return out
         if last is not None:
             raise last
         raise _BatchTimeout(
-            f"worker {worker_id} batch {batch_digest.hex()[:16]} "
+            f"worker {worker_id} batches {[d.hex()[:16] for d in digests[:3]]} "
             f"deadline ({self.batch_timeout}s)"
         )

@@ -74,6 +74,7 @@ class HeaderWaiter:
         by_worker: dict[int, list[Digest]] = {}
         for digest, worker_id in missing.items():
             by_worker.setdefault(worker_id, []).append(digest)
+        sends = []
         for worker_id, digests in by_worker.items():
             try:
                 address = self.worker_cache.worker(self.name, worker_id).worker_address
@@ -82,11 +83,17 @@ class HeaderWaiter:
                     "no local worker %d to sync %d batches", worker_id, len(digests)
                 )
                 continue
-            await self.network.unreliable_send(
-                address, SynchronizeMsg(tuple(digests), author)
+            sends.append(
+                self.network.unreliable_send(
+                    address, SynchronizeMsg(tuple(digests), author)
+                )
             )
             if self.metrics is not None:
                 self.metrics.sync_batch_requests.inc()
+        if sends:
+            # Concurrent fan-out: one coalesced Synchronize per worker, all
+            # workers in flight together.
+            await asyncio.gather(*sends)
 
     async def _fetch_certificates(self, digests: list[Digest], address: str) -> None:
         """Request parent certificates and feed replies into the core's

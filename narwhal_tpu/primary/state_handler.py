@@ -69,8 +69,9 @@ class StateHandler:
             return
         self.last_committed_round = round
         self.tx_consensus_round_updates.send(round)
-        for address in self._our_worker_addresses():
-            await self.network.unreliable_send(address, CleanupMsg(round))
+        await self.network.unreliable_broadcast(
+            self._our_worker_addresses(), CleanupMsg(round)
+        )
 
     async def _handle_reconfigure(self, note: ReconfigureNotification) -> None:
         """(state_handler.rs:100-172): swap the committee, notify every local
@@ -80,8 +81,7 @@ class StateHandler:
         self.tx_reconfigure.send(note)
         committee_json = note.committee.to_json() if note.committee is not None else ""
         msg = ReconfigureMsg(note.kind, committee_json)
-        for address in self._our_worker_addresses():
-            await self.network.unreliable_send(address, msg)
+        await self.network.unreliable_broadcast(self._our_worker_addresses(), msg)
         if note.kind == "shutdown":
             logger.info("State handler executing shutdown")
 

@@ -221,6 +221,11 @@ class BatchStore:
     def read(self, digest: Digest) -> bytes | None:
         return self._cf.get(digest)
 
+    def read_all(self, digests: Iterable[Digest]) -> list[bytes | None]:
+        """One coalesced engine read for a whole fetch group (the server
+        side of RequestBatchesMsg): per-digest presence, request order."""
+        return self._cf.get_all(digests)
+
     async def notify_read(self, digest: Digest) -> bytes:
         return await self._cf.notify_read(digest)
 

@@ -26,7 +26,9 @@ from ..messages import (
     DeleteBatchesMsg,
     DeletedBatchesMsg,
     ReconfigureMsg,
+    RequestBatchesMsg,
     RequestBatchMsg,
+    RequestedBatchesMsg,
     RequestedBatchMsg,
     SubmitTransactionMsg,
     SubmitTransactionStreamMsg,
@@ -144,6 +146,9 @@ class Worker:
         self.server.route(CleanupMsg, self._on_cleanup, allow=allow_own_primary)
         self.server.route(
             RequestBatchMsg, self._on_request_batch, allow=allow_own_primary
+        )
+        self.server.route(
+            RequestBatchesMsg, self._on_request_batches, allow=allow_own_primary
         )
         self.server.route(
             DeleteBatchesMsg, self._on_delete_batches, allow=allow_own_primary
@@ -266,6 +271,17 @@ class Worker:
             return RequestedBatchMsg(msg.digest, b"", found=False)
         # Serve the stored wire bytes as-is; decoding is the requester's.
         return RequestedBatchMsg(msg.digest, raw)
+
+    async def _on_request_batches(self, msg: RequestBatchesMsg, peer: str):
+        # One coalesced store read answers the whole group; entries are
+        # byte-identical to the per-digest RequestBatchMsg responses.
+        raws = self.store.read_all(msg.digests)
+        return RequestedBatchesMsg(
+            tuple(
+                (d, raw is not None, raw if raw is not None else b"")
+                for d, raw in zip(msg.digests, raws)
+            )
+        )
 
     async def _on_delete_batches(self, msg: DeleteBatchesMsg, peer: str):
         self.store.delete_all(msg.digests)

@@ -1,0 +1,25 @@
+"""Trips no-per-item-rpc-in-loop: awaited network RPCs inside for-loops —
+one round trip per item on the commit-to-execution data plane."""
+
+import asyncio  # noqa: F401
+
+
+class Fetcher:
+    def __init__(self, network, client):
+        self.network = network
+        self.client = client
+
+    async def fetch_all(self, digests, addr, msg):
+        out = []
+        for d in digests:  # one RTT per digest: the seed subscriber bug
+            out.append(await self.network.request(addr, msg(d)))
+        return out
+
+    async def drain(self, stream, addr, msg):
+        async for item in stream:
+            await self.client.unreliable_send(addr, msg(item))
+
+
+async def broadcast_each(net, addrs, msg):
+    for a in addrs:  # bare-name network receiver
+        await net.request(a, msg)

@@ -1,28 +1,29 @@
 """BlockWaiter request orchestration: concurrent-request dedup, per-batch
-worker deadline, bounded transport retry.
+worker deadline, bounded transport retry, per-worker fetch coalescing.
 
 Reference semantics: /root/reference/primary/src/block_waiter.rs:45-845 —
-one in-flight fetch per block digest (pending map), RequestBatch to the
-worker holding each batch with a 10 s timeout mapped to BatchTimeout; a dead
-worker yields an error reply, never a hang.
+one in-flight fetch per block digest (pending map), the worker fetch under a
+10 s timeout mapped to BatchTimeout; a dead worker yields an error reply,
+never a hang. Delta: a block's batch fetches group by target worker and ride
+ONE coalesced RequestBatchesMsg per worker.
 """
 
 import asyncio
 
 from narwhal_tpu.config import WorkerInfo
 from narwhal_tpu.fixtures import CommitteeFixture
-from narwhal_tpu.messages import RequestBatchMsg, RequestedBatchMsg
+from narwhal_tpu.messages import RequestBatchesMsg, RequestedBatchesMsg
 from narwhal_tpu.network import NetworkClient, RpcServer
 from narwhal_tpu.primary.block_waiter import BlockError, BlockWaiter
 from narwhal_tpu.stores import NodeStorage
 from narwhal_tpu.types import Batch
 
 
-def _fixture_with_block(f, batch: Batch):
-    """Store a certificate whose payload names `batch` (worker 0); returns
+def _fixture_with_block(f, *batches: Batch):
+    """Store a certificate whose payload names `batches` (worker 0); returns
     (certificate, certificate_store)."""
     storage = NodeStorage(None)
-    header = f.header(author=0, round=1, payload={batch.digest: 0})
+    header = f.header(author=0, round=1, payload={b.digest: 0 for b in batches})
     cert = f.certificate(header)
     storage.certificate_store.write(cert)
     return cert, storage.certificate_store
@@ -45,8 +46,24 @@ def _waiter(f, store, **kwargs) -> BlockWaiter:
     )
 
 
+def _serve(*batches: Batch):
+    """A coalesced-fetch handler answering from `batches` (misses are
+    authoritative found=False entries, like the real worker)."""
+    by_digest = {b.digest: b.to_bytes() for b in batches}
+
+    async def on_request(msg: RequestBatchesMsg, peer):
+        return RequestedBatchesMsg(
+            tuple(
+                (d, d in by_digest, by_digest.get(d, b""))
+                for d in msg.digests
+            )
+        )
+
+    return on_request
+
+
 def test_concurrent_get_block_dedups_to_one_worker_rpc(run):
-    """Two concurrent fetches of the same block issue ONE RequestBatch to
+    """Two concurrent fetches of the same block issue ONE coalesced fetch to
     the worker (block_waiter.rs pending map)."""
 
     async def scenario():
@@ -55,14 +72,15 @@ def test_concurrent_get_block_dedups_to_one_worker_rpc(run):
         cert, store = _fixture_with_block(f, batch)
         calls = 0
         srv = RpcServer()
+        inner = _serve(batch)
 
-        async def on_request(msg: RequestBatchMsg, peer):
+        async def on_request(msg: RequestBatchesMsg, peer):
             nonlocal calls
             calls += 1
             await asyncio.sleep(0.1)  # hold both callers in flight
-            return RequestedBatchMsg(msg.digest, batch.to_bytes())
+            return await inner(msg, peer)
 
-        srv.route(RequestBatchMsg, on_request)
+        srv.route(RequestBatchesMsg, on_request)
         port = await srv.start("127.0.0.1", 0)
         _point_worker_at(f, port)
         waiter = _waiter(f, store)
@@ -77,6 +95,42 @@ def test_concurrent_get_block_dedups_to_one_worker_rpc(run):
             # issues a new RPC.
             await waiter.get_block(cert.digest)
             assert calls == 2
+        finally:
+            await srv.stop()
+
+    run(scenario())
+
+
+def test_multi_batch_block_coalesces_to_one_rpc(run):
+    """A block naming many batches on one worker costs ONE RequestBatchesMsg
+    round trip carrying every digest, not one RPC per batch."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        batches = [Batch((b"tx-%d" % i,)) for i in range(16)]
+        cert, store = _fixture_with_block(f, *batches)
+        calls = 0
+        digests_seen: list = []
+        srv = RpcServer()
+        inner = _serve(*batches)
+
+        async def on_request(msg: RequestBatchesMsg, peer):
+            nonlocal calls
+            calls += 1
+            digests_seen.extend(msg.digests)
+            return await inner(msg, peer)
+
+        srv.route(RequestBatchesMsg, on_request)
+        port = await srv.start("127.0.0.1", 0)
+        _point_worker_at(f, port)
+        waiter = _waiter(f, store)
+        try:
+            resp = await waiter.get_block(cert.digest)
+            assert calls == 1
+            assert sorted(digests_seen) == sorted(b.digest for b in batches)
+            fetched = dict(resp.batches)
+            for b in batches:
+                assert fetched[b.digest] == b
         finally:
             await srv.stop()
 
@@ -116,12 +170,13 @@ def test_slow_worker_maps_to_batch_timeout(run):
         batch = Batch((b"tx",))
         cert, store = _fixture_with_block(f, batch)
         srv = RpcServer()
+        inner = _serve(batch)
 
-        async def on_request(msg: RequestBatchMsg, peer):
+        async def on_request(msg: RequestBatchesMsg, peer):
             await asyncio.sleep(30.0)
-            return RequestedBatchMsg(msg.digest, batch.to_bytes())
+            return await inner(msg, peer)
 
-        srv.route(RequestBatchMsg, on_request)
+        srv.route(RequestBatchesMsg, on_request)
         port = await srv.start("127.0.0.1", 0)
         _point_worker_at(f, port)
         waiter = _waiter(f, store, batch_timeout=0.3)
@@ -152,11 +207,7 @@ def test_transient_worker_failure_retries_and_succeeds(run):
         waiter = _waiter(f, store, retry_attempts=4, retry_delay=0.2)
 
         srv = RpcServer()
-
-        async def on_request(msg: RequestBatchMsg, peer):
-            return RequestedBatchMsg(msg.digest, batch.to_bytes())
-
-        srv.route(RequestBatchMsg, on_request)
+        srv.route(RequestBatchesMsg, _serve(batch))
 
         async def bring_up_later():
             await asyncio.sleep(0.3)
@@ -174,23 +225,26 @@ def test_transient_worker_failure_retries_and_succeeds(run):
 
 
 def test_worker_lacking_batch_is_authoritative_no_retry(run):
-    """found=False is an authoritative answer: one RPC, immediate
-    BatchError (retrying our own worker for a batch it doesn't have is the
-    reference's BatchError reply path, not a retry case)."""
+    """A found=False entry in a partial response is an authoritative answer:
+    one RPC, immediate BatchError (retrying our own worker for a batch it
+    doesn't have is the reference's BatchError reply path, not a retry
+    case) — even when OTHER digests in the same response are found."""
 
     async def scenario():
         f = CommitteeFixture(size=4)
-        batch = Batch((b"tx",))
-        cert, store = _fixture_with_block(f, batch)
+        have = Batch((b"tx-have",))
+        lack = Batch((b"tx-lack",))
+        cert, store = _fixture_with_block(f, have, lack)
         calls = 0
         srv = RpcServer()
+        inner = _serve(have)  # `lack` answers found=False
 
-        async def on_request(msg: RequestBatchMsg, peer):
+        async def on_request(msg: RequestBatchesMsg, peer):
             nonlocal calls
             calls += 1
-            return RequestedBatchMsg(msg.digest, b"", found=False)
+            return await inner(msg, peer)
 
-        srv.route(RequestBatchMsg, on_request)
+        srv.route(RequestBatchesMsg, on_request)
         port = await srv.start("127.0.0.1", 0)
         _point_worker_at(f, port)
         waiter = _waiter(f, store)
@@ -217,10 +271,12 @@ def test_corrupt_batch_bytes_rejected(run):
         cert, store = _fixture_with_block(f, batch)
         srv = RpcServer()
 
-        async def on_request(msg: RequestBatchMsg, peer):
-            return RequestedBatchMsg(msg.digest, Batch((b"evil",)).to_bytes())
+        async def on_request(msg: RequestBatchesMsg, peer):
+            return RequestedBatchesMsg(
+                tuple((d, True, Batch((b"evil",)).to_bytes()) for d in msg.digests)
+            )
 
-        srv.route(RequestBatchMsg, on_request)
+        srv.route(RequestBatchesMsg, on_request)
         port = await srv.start("127.0.0.1", 0)
         _point_worker_at(f, port)
         waiter = _waiter(f, store)

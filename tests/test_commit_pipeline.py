@@ -1,0 +1,461 @@
+"""The pipelined commit-to-execution data plane.
+
+Covers the four layers of the coalesced-fetch + speculative-prefetch path:
+wire (RequestBatchesMsg answers byte-identical to N sequential RequestBatch
+calls), the subscriber's one-RPC-per-(worker, certificate) staging, the
+prefetcher's warm-cache / budget-eviction / gc_depth semantics, and the
+escalating diagnostics for permanently-failing fetches.
+"""
+
+import asyncio
+import logging
+
+import pytest
+
+from narwhal_tpu.channels import Channel
+from narwhal_tpu.executor.metrics import ExecutorMetrics
+from narwhal_tpu.executor.prefetcher import Prefetcher
+from narwhal_tpu.executor.subscriber import Subscriber
+from narwhal_tpu.fixtures import CommitteeFixture
+from narwhal_tpu.messages import (
+    RequestBatchesMsg,
+    RequestBatchMsg,
+    RequestedBatchesMsg,
+)
+from narwhal_tpu.metrics import Registry
+from narwhal_tpu.network import NetworkClient, RpcServer
+from narwhal_tpu.stores import NodeStorage
+from narwhal_tpu.types import Batch, ConsensusOutput
+from narwhal_tpu.worker import Worker
+
+
+def _rewire_worker(f, port: int) -> None:
+    from narwhal_tpu.config import WorkerInfo
+
+    pk = f.authorities[0].public
+    info = f.worker_cache.workers[pk][0]
+    f.worker_cache.workers[pk][0] = WorkerInfo(
+        name=info.name,
+        transactions=info.transactions,
+        worker_address=f"127.0.0.1:{port}",
+    )
+
+
+def _counting_server(*batches: Batch):
+    """(server, calls) where the server answers RequestBatchesMsg from
+    `batches` with authoritative found flags and counts fetch RPCs."""
+    by_digest = {b.digest: b.to_bytes() for b in batches}
+    calls = {"rpcs": 0}
+    srv = RpcServer()
+
+    async def on_request(msg: RequestBatchesMsg, peer):
+        calls["rpcs"] += 1
+        return RequestedBatchesMsg(
+            tuple((d, d in by_digest, by_digest.get(d, b"")) for d in msg.digests)
+        )
+
+    srv.route(RequestBatchesMsg, on_request)
+    return srv, calls
+
+
+def _subscriber(f, temp_store, metrics=None, prefetcher=None, **kw) -> Subscriber:
+    return Subscriber(
+        f.authorities[0].public,
+        f.worker_cache,
+        NetworkClient(),
+        temp_store,
+        rx_consensus=Channel(100),
+        tx_executor=Channel(100),
+        metrics=metrics,
+        prefetcher=prefetcher,
+        **kw,
+    )
+
+
+def _output(f, batches, round=1, index=0) -> ConsensusOutput:
+    cert = f.certificate(
+        f.header(author=0, round=round, payload={b.digest: 0 for b in batches})
+    )
+    return ConsensusOutput(certificate=cert, consensus_index=index)
+
+
+# ---------------------------------------------------------------------------
+# Wire equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_fetch_equivalent_to_sequential(run):
+    """One RequestBatchesMsg against a REAL worker returns entries
+    byte-identical to N sequential RequestBatchMsg calls, found and
+    not-found digests mixed, in request order."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        have = [Batch((b"tx-%d" % i, b"tx2-%d" % i)) for i in range(3)]
+        lack = [Batch((b"missing-%d" % i,)) for i in range(2)]
+        store = NodeStorage(None).batch_store
+        for b in have:
+            store.write(b.digest, b.to_bytes())
+        w = Worker(
+            f.authorities[0].public, 0, f.committee, f.worker_cache,
+            f.parameters, store,
+        )
+        await w.spawn()
+        try:
+            host_port = w.worker_address
+            net = NetworkClient()
+            # Interleave found and not-found digests.
+            digests = []
+            for h, m in zip(have, lack + [None, None]):
+                digests.append(h.digest)
+                if m is not None:
+                    digests.append(m.digest)
+            sequential = [
+                await net.request(host_port, RequestBatchMsg(d)) for d in digests
+            ]
+            coalesced = await net.request(
+                host_port, RequestBatchesMsg(tuple(digests))
+            )
+            assert len(coalesced.batches) == len(digests)
+            for (cd, cfound, craw), seq, d in zip(
+                coalesced.batches, sequential, digests
+            ):
+                assert cd == seq.digest == d
+                assert cfound == seq.found
+                assert craw == seq.serialized_batch  # byte-identical
+            net.close()
+        finally:
+            await w.shutdown()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Subscriber staging: RPC coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_staging_issues_one_rpc_for_sixteen_batches(run):
+    """The ISSUE acceptance bound: at 16 batches/certificate on one worker,
+    the coalesced plane issues >=8x fewer fetch RPCs than the per-batch
+    plane would (here: exactly 1 vs 16)."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        batches = [Batch((b"tx-%d" % i,)) for i in range(16)]
+        srv, calls = _counting_server(*batches)
+        port = await srv.start("127.0.0.1", 0)
+        _rewire_worker(f, port)
+        registry = Registry()
+        metrics = ExecutorMetrics(registry)
+        storage = NodeStorage(None)
+        sub = _subscriber(f, storage.temp_batch_store, metrics=metrics)
+        try:
+            output = _output(f, batches)
+            staged_output, staged, _t = await asyncio.wait_for(
+                sub._stage(output, 0.0), 10.0
+            )
+            assert staged_output is output
+            assert set(staged) == {b.digest for b in batches}
+            assert calls["rpcs"] == 1
+            assert len(batches) / calls["rpcs"] >= 8  # the acceptance bound
+            # The RPCs-per-certificate histogram saw one observation of 1.
+            h = registry.get("executor_fetch_rpcs_per_certificate")
+            child = h._default()
+            assert child.count == 1 and child.sum == 1.0
+            assert registry.value("executor_bytes_fetched") == sum(
+                len(b.to_bytes()) for b in batches
+            )
+            sub.network.close()
+        finally:
+            await srv.stop()
+
+    run(scenario())
+
+
+def test_staging_groups_by_worker(run):
+    """Batches spread over two workers cost one RPC per worker, issued
+    concurrently, and partial progress is preserved across retries."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=2)
+        b0 = [Batch((b"w0-%d" % i,)) for i in range(4)]
+        b1 = [Batch((b"w1-%d" % i,)) for i in range(4)]
+        srv0, calls0 = _counting_server(*b0)
+        srv1, calls1 = _counting_server(*b1)
+        from narwhal_tpu.config import WorkerInfo
+
+        pk = f.authorities[0].public
+        for wid, srv in ((0, srv0), (1, srv1)):
+            port = await srv.start("127.0.0.1", 0)
+            info = f.worker_cache.workers[pk][wid]
+            f.worker_cache.workers[pk][wid] = WorkerInfo(
+                name=info.name,
+                transactions=info.transactions,
+                worker_address=f"127.0.0.1:{port}",
+            )
+        storage = NodeStorage(None)
+        sub = _subscriber(f, storage.temp_batch_store)
+        try:
+            payload = {b.digest: 0 for b in b0} | {b.digest: 1 for b in b1}
+            cert = f.certificate(f.header(author=0, round=1, payload=payload))
+            output = ConsensusOutput(certificate=cert, consensus_index=0)
+            _, staged, _t = await asyncio.wait_for(sub._stage(output, 0.0), 10.0)
+            assert set(staged) == set(payload)
+            assert calls0["rpcs"] == 1 and calls1["rpcs"] == 1
+            sub.network.close()
+        finally:
+            await srv0.stop()
+            await srv1.stop()
+
+    run(scenario())
+
+
+def test_unknown_worker_id_escalates_to_warning(run, caplog):
+    """A payload naming a worker id absent from the worker cache used to
+    retry forever in silence (KeyError swallowed at debug); after ~5
+    attempts it must surface as a rate-limited warning with the attempt
+    count."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        batch = Batch((b"tx",))
+        storage = NodeStorage(None)
+        sub = _subscriber(
+            f, storage.temp_batch_store, initial_backoff=0.001, max_backoff=0.002
+        )
+        cert = f.certificate(
+            f.header(author=0, round=1, payload={batch.digest: 7})  # no worker 7
+        )
+        output = ConsensusOutput(certificate=cert, consensus_index=0)
+        with caplog.at_level(logging.WARNING, logger="narwhal.executor"):
+            task = asyncio.ensure_future(sub._stage(output, 0.0))
+            for _ in range(400):
+                await asyncio.sleep(0.005)
+                if any(
+                    "still failing after" in r.message for r in caplog.records
+                ):
+                    break
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        warnings = [r for r in caplog.records if "still failing after" in r.message]
+        assert warnings, "unknown worker_id never escalated past debug"
+        assert "unknown worker id 7" in warnings[0].getMessage()
+        sub.network.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher
+# ---------------------------------------------------------------------------
+
+
+def _prefetcher(f, temp_store, metrics=None, **kw) -> Prefetcher:
+    return Prefetcher(
+        f.authorities[0].public,
+        f.worker_cache,
+        NetworkClient(),
+        temp_store,
+        rx_accepted=Channel(100),
+        retry_delay=0.01,
+        metrics=metrics,
+        **kw,
+    )
+
+
+def test_warm_commit_is_a_local_hit_with_zero_rpcs(run):
+    """An accepted certificate's payload prefetched before commit makes the
+    commit-time staging pass entirely local: the prefetch hit-rate metric is
+    >0 and staging issues zero fetch RPCs."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        batches = [Batch((b"tx-%d" % i,)) for i in range(4)]
+        srv, calls = _counting_server(*batches)
+        port = await srv.start("127.0.0.1", 0)
+        _rewire_worker(f, port)
+        registry = Registry()
+        metrics = ExecutorMetrics(registry)
+        storage = NodeStorage(None)
+        pf = _prefetcher(f, storage.temp_batch_store, metrics=metrics)
+        sub = _subscriber(f, storage.temp_batch_store, metrics=metrics, prefetcher=pf)
+        try:
+            output = _output(f, batches)
+            # Acceptance-time: the certificate enters the DAG; the
+            # prefetcher warms the store rounds before commit.
+            await asyncio.wait_for(
+                pf._prefetch_burst([output.certificate]), 10.0
+            )
+            assert calls["rpcs"] == 1
+            assert registry.value("executor_prefetched_batches") == len(batches)
+            assert pf.resident_bytes == sum(len(b.to_bytes()) for b in batches)
+            # Commit-time: staging never touches the network.
+            _, staged, _t = await asyncio.wait_for(sub._stage(output, 0.0), 10.0)
+            assert set(staged) == {b.digest for b in batches}
+            assert calls["rpcs"] == 1  # no NEW rpcs at commit
+            assert registry.value("executor_prefetch_hits") > 0
+            assert registry.value("executor_prefetch_misses") == 0
+            # claim(): the commit took ownership of every prefetched entry.
+            assert pf.resident_bytes == 0
+            pf.network.close()
+            sub.network.close()
+        finally:
+            await srv.stop()
+
+    run(scenario())
+
+
+def test_budget_eviction_falls_back_to_fetch(run):
+    """Over-budget speculation evicts the OLDEST unclaimed payload; a later
+    commit of the evicted certificate misses locally and transparently falls
+    back to the coalesced fetch — eviction can cost a round trip, never
+    correctness."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        b1 = Batch((b"first-" + b"x" * 64,))
+        b2 = Batch((b"second-" + b"y" * 64,))
+        srv, calls = _counting_server(b1, b2)
+        port = await srv.start("127.0.0.1", 0)
+        _rewire_worker(f, port)
+        storage = NodeStorage(None)
+        # Budget fits exactly one of the two batches.
+        budget = max(len(b1.to_bytes()), len(b2.to_bytes())) + 8
+        pf = _prefetcher(f, storage.temp_batch_store, budget_bytes=budget)
+        sub = _subscriber(f, storage.temp_batch_store, prefetcher=pf)
+        try:
+            out1 = _output(f, [b1], round=1, index=0)
+            out2 = _output(f, [b2], round=2, index=1)
+            await asyncio.wait_for(pf._prefetch_burst([out1.certificate]), 10.0)
+            await asyncio.wait_for(pf._prefetch_burst([out2.certificate]), 10.0)
+            # b1 was evicted to admit b2.
+            assert storage.temp_batch_store.read(b1.digest) is None
+            assert storage.temp_batch_store.read(b2.digest) is not None
+            assert pf.resident_bytes <= budget
+            rpcs_before = calls["rpcs"]
+            # Committing the evicted certificate still succeeds — via fetch.
+            _, staged1, _t = await asyncio.wait_for(sub._stage(out1, 0.0), 10.0)
+            assert staged1[b1.digest] == b1
+            assert calls["rpcs"] == rpcs_before + 1
+            # The warm certificate commits with zero new RPCs.
+            _, staged2, _t = await asyncio.wait_for(sub._stage(out2, 0.0), 10.0)
+            assert staged2[b2.digest] == b2
+            assert calls["rpcs"] == rpcs_before + 1
+            pf.network.close()
+            sub.network.close()
+        finally:
+            await srv.stop()
+
+    run(scenario())
+
+
+def test_claimed_payload_is_never_evicted(run):
+    """Once a commit claims its digests (committed-but-unexecuted), budget
+    pressure from later speculation must not delete them from the store."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        b1 = Batch((b"committed-" + b"x" * 64,))
+        b2 = Batch((b"speculative-" + b"y" * 64,))
+        srv, calls = _counting_server(b1, b2)
+        port = await srv.start("127.0.0.1", 0)
+        _rewire_worker(f, port)
+        storage = NodeStorage(None)
+        budget = max(len(b1.to_bytes()), len(b2.to_bytes())) + 8
+        pf = _prefetcher(f, storage.temp_batch_store, budget_bytes=budget)
+        sub = _subscriber(f, storage.temp_batch_store, prefetcher=pf)
+        try:
+            out1 = _output(f, [b1], round=1, index=0)
+            await asyncio.wait_for(pf._prefetch_burst([out1.certificate]), 10.0)
+            # Commit claims b1: ownership moves to the execution path.
+            await asyncio.wait_for(sub._stage(out1, 0.0), 10.0)
+            # Later speculation would have evicted b1 under budget pressure;
+            # claimed entries are no longer eviction candidates.
+            out2 = _output(f, [b2], round=2, index=1)
+            await asyncio.wait_for(pf._prefetch_burst([out2.certificate]), 10.0)
+            assert storage.temp_batch_store.read(b1.digest) is not None
+            assert storage.temp_batch_store.read(b2.digest) is not None
+            pf.network.close()
+            sub.network.close()
+        finally:
+            await srv.stop()
+
+    run(scenario())
+
+
+def test_never_committed_prefetch_gcd_past_gc_depth(run):
+    """Speculative payload of a certificate that never commits is deleted
+    once the accepted round-front moves gc_depth past its round — exactly
+    the DAG's garbage horizon, so lost branches can't leak store bytes."""
+
+    async def scenario():
+        from narwhal_tpu.fixtures import mock_certificate
+        from narwhal_tpu.types import Certificate
+
+        f = CommitteeFixture(size=4)
+        batch = Batch((b"never-commits",))
+        srv, calls = _counting_server(batch)
+        port = await srv.start("127.0.0.1", 0)
+        _rewire_worker(f, port)
+        storage = NodeStorage(None)
+        pf = _prefetcher(f, storage.temp_batch_store, gc_depth=5)
+        try:
+            loser = _output(f, [batch], round=1)
+            await asyncio.wait_for(pf._prefetch_burst([loser.certificate]), 10.0)
+            assert storage.temp_batch_store.read(batch.digest) is not None
+            # The round front advances without that certificate committing.
+            genesis = {c.digest for c in Certificate.genesis(f.committee)}
+            front = [
+                mock_certificate(
+                    f.committee, f.authorities[1].public, r, genesis
+                )
+                for r in (3, 7)
+            ]
+            await asyncio.wait_for(pf._prefetch_burst(front), 10.0)
+            assert storage.temp_batch_store.read(batch.digest) is None
+            assert pf.resident_bytes == 0
+            pf.network.close()
+        finally:
+            await srv.stop()
+
+    run(scenario())
+
+
+def test_prefetcher_actor_end_to_end_via_tap_channel(run):
+    """The spawned actor drains the accepted-certificate tap and warms the
+    store in the background (the node.py wiring, minus the primary)."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        batches = [Batch((b"bg-%d" % i,)) for i in range(3)]
+        srv, calls = _counting_server(*batches)
+        port = await srv.start("127.0.0.1", 0)
+        _rewire_worker(f, port)
+        storage = NodeStorage(None)
+        pf = _prefetcher(f, storage.temp_batch_store)
+        task = pf.spawn()
+        try:
+            output = _output(f, batches)
+            await pf.rx_accepted.send(output.certificate)
+            for _ in range(200):
+                if all(
+                    storage.temp_batch_store.read(b.digest) is not None
+                    for b in batches
+                ):
+                    break
+                await asyncio.sleep(0.01)
+            assert all(
+                storage.temp_batch_store.read(b.digest) is not None
+                for b in batches
+            )
+            assert calls["rpcs"] == 1
+        finally:
+            task.cancel()
+            pf.network.close()
+            await srv.stop()
+
+    run(scenario())

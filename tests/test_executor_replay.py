@@ -60,6 +60,61 @@ def _run(coro):
 
 
 @pytest.mark.parametrize("crash_at", list(range(0, 7)))
+def test_exactly_once_output_stream_across_crash_points(crash_at):
+    """The burst-drain core's output channel is exactly-once too: results
+    applied before a mid-batch crash are flushed (never lost in the burst
+    buffer), and replay — which skips below the watermark — never re-emits
+    them. The full stream after restart is each tx once, in order."""
+
+    async def scenario():
+        f = CommitteeFixture(size=4)
+        batches = {
+            b"\x01" * 32: Batch(tuple(b"a%d" % i for i in range(4))),
+            b"\x02" * 32: Batch(tuple(b"b%d" % i for i in range(2))),
+        }
+        payload = {d: 0 for d in batches}
+        output = _output(f, payload)
+        expected = [b"a0", b"a1", b"a2", b"a3", b"b0", b"b1"]
+
+        state = JournalState()
+        storage = NodeStorage(None)
+        tx_output = Channel(100)
+        core = ExecutorCore(
+            state,
+            storage.temp_batch_store,
+            rx_subscriber=Channel(10),
+            tx_output=tx_output,
+        )
+        core.execution_indices = await state.load_execution_indices()
+        state.crash_at = crash_at
+        try:
+            await core.execute_certificate(output, batches)
+        except Crash:
+            pass
+        state.crash_at = None
+        recovered = await state.load_execution_indices()
+        if recovered.next_certificate_index <= output.consensus_index:
+            core2 = ExecutorCore(
+                state,
+                storage.temp_batch_store,
+                rx_subscriber=Channel(10),
+                tx_output=tx_output,
+            )
+            core2.execution_indices = recovered
+            await core2.execute_certificate(output, batches)
+        assert state.journal == expected
+        emitted = []
+        while True:
+            item = tx_output.try_recv()
+            if item is None:
+                break
+            emitted.append(item[1])
+        assert emitted == expected, f"crash at {crash_at}: outputs {emitted}"
+
+    _run(scenario())
+
+
+@pytest.mark.parametrize("crash_at", list(range(0, 7)))
 def test_exactly_once_across_crash_points(crash_at):
     """Two batches (4 + 2 txs, ordered by digest): crash before the Nth
     transaction for every N — including N=4, the batch boundary — restart,

@@ -82,11 +82,15 @@ def _golden_messages():
         M.SynchronizeMsg: M.SynchronizeMsg((d1,), pk),
         M.CleanupMsg: M.CleanupMsg(7),
         M.RequestBatchMsg: M.RequestBatchMsg(d1),
+        M.RequestBatchesMsg: M.RequestBatchesMsg((d1, d2)),
         M.DeleteBatchesMsg: M.DeleteBatchesMsg((d1, d2)),
         M.ReconfigureMsg: M.ReconfigureMsg("new_epoch", "{}"),
         M.OurBatchMsg: M.OurBatchMsg(d1, 0),
         M.OthersBatchMsg: M.OthersBatchMsg(d2, 1),
         M.RequestedBatchMsg: M.RequestedBatchMsg(d1, b"\x33" * 8, True),
+        M.RequestedBatchesMsg: M.RequestedBatchesMsg(
+            ((d1, True, b"\x33" * 8), (d2, False, b""))
+        ),
         M.DeletedBatchesMsg: M.DeletedBatchesMsg((d1,)),
         M.WorkerErrorMsg: M.WorkerErrorMsg("boom"),
         M.WorkerBatchMsg: M.WorkerBatchMsg(Batch((b"tx",)).to_bytes()),

@@ -123,12 +123,19 @@ MESSAGE_STRATEGIES = {
     M.SynchronizeMsg: st.builds(M.SynchronizeMsg, _digest_tuple, pubkey),
     M.CleanupMsg: st.builds(M.CleanupMsg, rnd),
     M.RequestBatchMsg: st.builds(M.RequestBatchMsg, digest),
+    M.RequestBatchesMsg: st.builds(M.RequestBatchesMsg, _digest_tuple),
     M.DeleteBatchesMsg: st.builds(M.DeleteBatchesMsg, _digest_tuple),
     M.ReconfigureMsg: st.builds(M.ReconfigureMsg, short_text, short_text),
     M.OurBatchMsg: st.builds(M.OurBatchMsg, digest, st.integers(0, 2**31)),
     M.OthersBatchMsg: st.builds(M.OthersBatchMsg, digest, st.integers(0, 2**31)),
     M.RequestedBatchMsg: st.builds(
         M.RequestedBatchMsg, digest, small_bytes, st.booleans()
+    ),
+    M.RequestedBatchesMsg: st.builds(
+        M.RequestedBatchesMsg,
+        st.lists(st.tuples(digest, st.booleans(), small_bytes), max_size=3).map(
+            tuple
+        ),
     ),
     M.DeletedBatchesMsg: st.builds(M.DeletedBatchesMsg, _digest_tuple),
     M.WorkerErrorMsg: st.builds(M.WorkerErrorMsg, short_text),

@@ -81,6 +81,7 @@ EXPECTED_RULES = {
     "no-shared-decode-mutation",
     "no-silent-except",
     "no-sync-store-write-in-async",
+    "no-per-item-rpc-in-loop",
 }
 
 FIXTURE_FOR = {
@@ -99,6 +100,10 @@ FIXTURE_FOR = {
     "no-sync-store-write-in-async": (
         "primary/sync_store_write_trip.py",
         "primary/sync_store_write_clean.py",
+    ),
+    "no-per-item-rpc-in-loop": (
+        "executor/per_item_rpc_trip.py",
+        "executor/per_item_rpc_clean.py",
     ),
 }
 
@@ -138,6 +143,7 @@ def test_fixture_finding_counts():
         "no-shared-decode-mutation": 4,  # field, nested container, mutator, direct
         "no-silent-except": 2,  # pass-only swallow, broad unlogged catch
         "no-sync-store-write-in-async": 4,  # store write/put, engine batch, bare store
+        "no-per-item-rpc-in-loop": 3,  # for+attr recv, async for, bare name
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -8,6 +8,7 @@
 | jit-purity                | host side effects baked into a traced TPU kernel  |
 | no-shared-decode-mutation | the ADVICE r5 medium: decode-cache corruption     |
 | no-silent-except          | swallowed failures in the consensus-critical dirs |
+| no-per-item-rpc-in-loop   | RTT x items serialization on the commit data plane|
 
 Rules are pure `ast` visitors over one `Module` at a time; registration is
 import-time via the `@register` decorator so `RULES` is the single catalog
@@ -654,6 +655,82 @@ class NoSyncStoreWriteInAsync(Rule):
                     "`write_batch_async`) so the write rides a fused "
                     "group commit",
                 )
+
+
+# ---------------------------------------------------------------------------
+# no-per-item-rpc-in-loop
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoPerItemRpcInLoop(Rule):
+    name = "no-per-item-rpc-in-loop"
+    summary = (
+        "in executor/ and primary/, an awaited network RPC inside a for-loop "
+        "pays one round trip per item (RTT x batches on the commit path); "
+        "coalesce the digests into one batched request (RequestBatchesMsg, "
+        "CertificatesBatchRequest) or fan out with asyncio.gather — bounded "
+        "retry loops over ONE coalesced request carry a justified "
+        "`# lint: allow(no-per-item-rpc-in-loop)`"
+    )
+
+    _SCOPED_DIRS = frozenset({"executor", "primary"})
+    _RPC_METHODS = {"request", "unreliable_send"}
+    # Receiver-name heuristic for RPC-client-shaped objects; plain
+    # `queue.request(...)` on unrelated receivers never matches.
+    _NET_SEGMENTS = frozenset(
+        {"network", "_network", "net", "_net", "client", "_client", "peer"}
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        seen: set[tuple[int, int]] = set()
+        for loop_node in ast.walk(mod.tree):
+            if not isinstance(loop_node, (ast.For, ast.AsyncFor)):
+                continue
+            for node in self._loop_nodes(loop_node):
+                if not (
+                    isinstance(node, ast.Await)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in self._RPC_METHODS
+                ):
+                    continue
+                recv = dotted(node.value.func.value)
+                if recv is None or not self._is_network_receiver(recv):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:  # nested loops: report once
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    mod,
+                    node,
+                    f"`await {recv}.{node.value.func.attr}(...)` inside a "
+                    "for-loop serializes one RPC round trip per item — "
+                    "coalesce the loop's items into one batched request, or "
+                    "justify a bounded retry loop with "
+                    "`# lint: allow(no-per-item-rpc-in-loop)`",
+                )
+
+    def _is_network_receiver(self, recv: str) -> bool:
+        return any(
+            seg in self._NET_SEGMENTS or "network" in seg.lower()
+            for seg in recv.split(".")
+        )
+
+    def _loop_nodes(self, loop_node: ast.AST) -> Iterator[ast.AST]:
+        """Walk a loop's body (and else) without descending into nested
+        function definitions — a helper defined inside the loop runs on its
+        own schedule (often gathered), not once per iteration."""
+        stack = list(loop_node.body) + list(loop_node.orelse)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
 
 
 # ---------------------------------------------------------------------------
