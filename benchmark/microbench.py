@@ -23,6 +23,20 @@ import pstats
 import time
 
 
+def _percentiles(samples: list[float], scale: float = 1.0) -> dict:
+    """p50/p95/p99 of a sample list (already-collected per-iteration times).
+    Means hide the tail that latency work exists to control, so every bench
+    that times per-iteration reports these alongside the mean."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None}
+    s = sorted(samples)
+
+    def pct(p: float) -> float:
+        return round(s[min(len(s) - 1, int(p * len(s)))] * scale, 3)
+
+    return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+
+
 def bench_batch_digest() -> list[dict]:
     """Serialized-batch digest vs decode-then-digest (batch_digest.rs)."""
     from narwhal_tpu.types import Batch, serialized_batch_digest
@@ -435,11 +449,14 @@ def bench_commit_path(
             staged = await fn()  # warm connections/compile nothing
             assert set(staged) == set(digests)
             rpcs0 = calls["rpcs"]
-            n, t0 = 0, time.perf_counter()
+            samples: list[float] = []
+            t0 = time.perf_counter()
             while time.perf_counter() - t0 < 0.5:
+                it0 = time.perf_counter()
                 await fn()
-                n += 1
-            dt = (time.perf_counter() - t0) / n
+                samples.append(time.perf_counter() - it0)
+            n = len(samples)
+            dt = sum(samples) / n
             rpcs_per_cert = (calls["rpcs"] - rpcs0) / n
             results[mode] = rpcs_per_cert
             rows.append(
@@ -450,6 +467,7 @@ def bench_commit_path(
                     "batches_per_cert": n_batches,
                     "txs_per_batch": txs_per_batch,
                     "rpcs_per_certificate": round(rpcs_per_cert, 2),
+                    "latency_ms": _percentiles(samples, scale=1000),
                 }
             )
         rows.append(
@@ -469,6 +487,89 @@ def bench_commit_path(
     out = []
     for n_batches in batches_per_cert:
         out.extend(asyncio.run(run_point(n_batches)))
+    return out
+
+
+def bench_pacing(
+    rates=(50, 400), duration: float = 2.0, ceiling: float = 0.1,
+    floor: float = 0.005, batch_size: int = 500_000, tx_bytes: int = 128,
+) -> list[dict]:
+    """Ingest-to-seal latency through a real BatchMaker, fixed delay vs the
+    adaptive pacing controller, at a light trickle and a heavier rate.
+
+    Each transaction's latency is measured from channel send to the sealed
+    batch containing it arriving downstream. The claim under test: with
+    shallow queues the adaptive controller seals near its floor (sub-10ms
+    p50 instead of ~ceiling/2 + ceiling tail), and the response is monotone
+    — at higher occupancy the delay climbs back toward the ceiling rather
+    than staying greedy."""
+    import asyncio
+
+    from narwhal_tpu.channels import Channel, Watch
+    from narwhal_tpu.pacing import PacingController
+    from narwhal_tpu.types import ReconfigureNotification
+    from narwhal_tpu.worker.batch_maker import BatchMaker
+
+    async def run_mode(rate: int, adaptive: bool) -> list[float]:
+        rx: Channel = Channel(10_000)
+        out: Channel = Channel(10_000)
+        pacing = (
+            PacingController(
+                ceiling=ceiling, floor=floor, sources=[rx.occupancy, out.occupancy]
+            )
+            if adaptive
+            else None
+        )
+        bm = BatchMaker(
+            batch_size, ceiling, rx, out,
+            Watch(ReconfigureNotification("boot")), pacing=pacing,
+        )
+        task = bm.spawn()
+        sent: dict[int, float] = {}
+        latencies: list[float] = []
+
+        async def drain() -> None:
+            while True:
+                batch = await out.recv()
+                t = time.perf_counter()
+                for tx in batch.transactions:
+                    sid = int.from_bytes(tx[:8], "big")
+                    t0 = sent.pop(sid, None)
+                    if t0 is not None:
+                        latencies.append(t - t0)
+
+        drainer = asyncio.ensure_future(drain())
+        interval = 1.0 / rate
+        end = time.perf_counter() + duration
+        sid = 0
+        while time.perf_counter() < end:
+            sid += 1
+            tx = sid.to_bytes(8, "big").ljust(tx_bytes, b"\x5a")
+            frame = len(tx).to_bytes(4, "little") + tx
+            sent[sid] = time.perf_counter()
+            await rx.send((1, frame))
+            await asyncio.sleep(interval)
+        await asyncio.sleep(ceiling * 2)  # let the tail seal
+        task.cancel()
+        drainer.cancel()
+        return latencies
+
+    out = []
+    for rate in rates:
+        for label, adaptive in (("fixed", False), ("adaptive", True)):
+            lat = asyncio.run(run_mode(rate, adaptive))
+            out.append(
+                {
+                    "metric": f"pacing_seal_latency_ms[{label}]",
+                    "value": round(1000 * sum(lat) / max(1, len(lat)), 3),
+                    "unit": "ms (mean)",
+                    "rate_tx_s": rate,
+                    "ceiling_ms": ceiling * 1000,
+                    "floor_ms": floor * 1000,
+                    "samples": len(lat),
+                    "latency_ms": _percentiles(lat, scale=1000),
+                }
+            )
     return out
 
 
@@ -530,6 +631,9 @@ def main() -> None:
     ap.add_argument("--commit-path", action="store_true",
                     help="run ONLY the commit->execution staging bench "
                          "(per-batch vs coalesced vs prefetch-warm)")
+    ap.add_argument("--pacing", action="store_true",
+                    help="run ONLY the adaptive-vs-fixed seal latency bench "
+                         "(ingest->seal percentiles through a real BatchMaker)")
     ap.add_argument("--out", default=None,
                     help="also write the selected benches as a JSON array to this path")
     args = ap.parse_args()
@@ -540,6 +644,8 @@ def main() -> None:
         rows += bench_rpc_coalesce()
     elif args.commit_path:
         rows += bench_commit_path()
+    elif args.pacing:
+        rows += bench_pacing()
     elif args.dag_service:
         rows += bench_dag_service()
     else:

@@ -110,6 +110,18 @@ async def _run_node(args) -> None:
         )
         await node.spawn()
         registry = node.registry
+
+        # A standalone primary has no embedding draining the execution
+        # output channel: without a consumer it fills after ~10k applied
+        # transactions, wedging the executor's output flush and pinning the
+        # backpressure level at 1.0 forever. Drain and drop — the default
+        # no-op execution state has no application consumer by definition.
+        async def _drain_execution_output() -> None:
+            ch = node.tx_execution_output
+            while True:
+                await ch.recv()
+
+        _exec_drain = asyncio.ensure_future(_drain_execution_output())
     else:
         worker_seed = keys.get("worker_network_seeds", {}).get(str(args.id))
         if worker_seed is None and not args.insecure:

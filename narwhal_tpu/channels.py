@@ -38,7 +38,24 @@ class Channel(Generic[T]):
 
     def __init__(self, capacity: int = DEFAULT_CHANNEL_CAPACITY, gauge: Gauge | None = None):
         self._q: asyncio.Queue[T] = asyncio.Queue(maxsize=capacity)
+        self._capacity = max(1, capacity)
         self._gauge = gauge
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def depth(self) -> int:
+        """Items currently queued — the observability hook the pacing
+        controller and backpressure monitor read (alongside the per-channel
+        depth gauge metered_channel registers)."""
+        return self._q.qsize()
+
+    def occupancy(self) -> float:
+        """depth/capacity in [0, 1]: the unit every pacing/admission
+        watermark is expressed in, so channels of different capacities feed
+        one controller without per-channel scaling."""
+        return self._q.qsize() / self._capacity
 
     async def send(self, item: T) -> None:
         await self._q.put(item)

@@ -75,10 +75,17 @@ class Cluster:
         dag_backend: str = "cpu",
         dag_shards: int = 1,
         consensus_protocol: str = "bullshark",
+        max_header_delay: float = 0.05,
+        max_batch_delay: float = 0.05,
     ):
         self.fixture = CommitteeFixture(size=size, workers=workers)
+        # The delay kwargs override the fixture defaults (fast rounds for
+        # tests) but an explicitly passed Parameters wins outright — latency
+        # tests/benches can exercise real configurations either way.
         self.parameters = parameters or replace(
-            self.fixture.parameters, max_header_delay=0.05, max_batch_delay=0.05
+            self.fixture.parameters,
+            max_header_delay=max_header_delay,
+            max_batch_delay=max_batch_delay,
         )
         if crypto_backend == "tpu" and parameters is None:
             # Default only: every node in this in-process cluster runs the

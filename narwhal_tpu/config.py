@@ -80,6 +80,38 @@ class Parameters:
     # temp batch store never exceeds this; 0 disables prefetching entirely.
     # Env override: NARWHAL_PREFETCH_BUDGET (bytes, read at node assembly).
     prefetch_budget: int = 64 << 20
+    # -- adaptive pacing (pacing.PacingController) -------------------------
+    # max_batch_delay / max_header_delay become CEILINGS: the effective
+    # delay shrinks toward these floors when the channel-depth EWMA says
+    # queues are shallow (latency mode) and grows back toward the ceiling
+    # under load (throughput mode). NARWHAL_PACING=0 disables adaptation
+    # (fixed ceilings, the seed behavior); NARWHAL_BATCH_DELAY_FLOOR /
+    # NARWHAL_HEADER_DELAY_FLOOR override the floors (seconds).
+    batch_delay_floor: float = 0.005
+    header_delay_floor: float = 0.02
+    pacing_low_occupancy: float = 0.05  # EWMA at/below -> floor delay
+    pacing_high_occupancy: float = 0.5  # EWMA at/above -> ceiling delay
+    pacing_ewma_alpha: float = 0.2
+    # -- end-to-end admission control (pacing.IngestGate) ------------------
+    # Policy at the worker's client-facing ingest once the admission level
+    # (max of local ingest occupancy and the primary-pushed downstream
+    # backlog) crosses the high watermark: 'shed' answers RESOURCE_EXHAUSTED
+    # immediately, 'block' holds the submission until the level falls below
+    # the low watermark (bounded, then sheds), 'off' restores the seed's
+    # unbounded queueing. Env override: NARWHAL_INGEST_POLICY.
+    ingest_policy: str = "shed"
+    backpressure_high_watermark: float = 0.75  # occupancy fraction
+    backpressure_low_watermark: float = 0.5  # hysteresis release
+    backpressure_poll_interval: float = 0.25  # primary->worker push period, s
+    backpressure_stale_after: float = 2.0  # worker fails OPEN past this, s
+    # Overload is mostly SERVICE-TIME saturation, not queue depth (items on
+    # the hot channels are whole batches/certificates, so channels stay
+    # shallow while rounds take seconds): the admission level also tracks
+    # the commit-stage latency EWMA against this target — EWMA == target
+    # lands on the high watermark, and a commit STALL longer than the
+    # target pins the level at 1.0. 0 disables the latency signals.
+    # Env override: NARWHAL_COMMIT_LATENCY_TARGET (seconds).
+    commit_latency_target: float = 4.0
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)
@@ -98,6 +130,25 @@ class Parameters:
     def import_(path: str) -> "Parameters":
         with open(path) as f:
             return Parameters.from_json(f.read())
+
+
+def pacing_enabled() -> bool:
+    """NARWHAL_PACING=0/false/off pins the seal/header delays at their
+    configured ceilings (the pre-pacing behavior); anything else adapts."""
+    return os.environ.get("NARWHAL_PACING", "1").lower() not in ("0", "false", "off")
+
+
+def env_float(name: str, default: float) -> float:
+    """Environment override for a float knob; non-numeric values are
+    ignored loudly rather than crashing the boot."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r (using %s)", name, raw, default)
+        return default
 
 
 @dataclass(frozen=True)

@@ -3,10 +3,22 @@
 from __future__ import annotations
 
 from ..metrics import Registry
+from ..pacing import StageTimer
 
 
 class ConsensusMetrics:
     def __init__(self, registry: Registry):
+        # -- stage tracing --------------------------------------------------
+        self.stage_latency = registry.histogram(
+            "consensus_stage_latency_seconds",
+            "Per-stage pipeline latency in consensus (stage=commit: "
+            "certificate accepted by the ordering engine -> sequenced in a "
+            "committed leader's causal history)",
+            labels=("stage",),
+        )
+        # Bounded: certificates that never commit (GC'd past the window)
+        # age out of the pending map instead of leaking.
+        self.commit_timer = StageTimer(self.stage_latency, "commit")
         self.last_committed_round = registry.gauge(
             "consensus_last_committed_round", "The last committed leader round"
         )

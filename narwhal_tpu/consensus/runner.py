@@ -101,6 +101,10 @@ class Consensus:
                     for certificate in certs:
                         if certificate.epoch != self.committee.epoch:
                             continue  # stale epoch, drop
+                        if self.metrics is not None:
+                            # Stage tracing: acceptance -> sequenced in a
+                            # committed causal history (_process stops it).
+                            self.metrics.commit_timer.start(certificate.digest)
                         if self.tx_accepted is not None:
                             # Speculative prefetch tap: batch digests are
                             # known NOW, rounds before this certificate can
@@ -148,6 +152,7 @@ class Consensus:
             if self.metrics is not None:
                 self.metrics.last_committed_round.set(self.state.last_committed_round)
                 self.metrics.committed_certificates.inc()
+                self.metrics.commit_timer.stop(cert.digest)
             await self.tx_primary.send(cert)
             await self.tx_output.send(output)
         if self.metrics is not None:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import asyncio
 
-from ..channels import Channel
+from ..channels import Channel, metered_channel
 from ..config import WorkerCache
 from ..network import NetworkClient
 from ..stores import CertificateStore, ConsensusStore, NodeStorage
@@ -75,7 +75,14 @@ class Executor:
         prefetch_budget: int | None = None,  # bytes; 0/None w/o tap disables
     ):
         metrics = ExecutorMetrics(registry) if registry is not None else None
-        self.tx_executor = Channel(1_000)
+        # Staged-payload hand-off (subscriber -> core), depth-gauged like
+        # every other inter-actor edge: its occupancy is one of the signals
+        # the node's backpressure monitor folds into the admission level.
+        self.tx_executor = (
+            metered_channel(registry, "executor", "core", 1_000)
+            if registry is not None
+            else Channel(1_000)
+        )
         self.prefetcher: Prefetcher | None = None
         if rx_accepted is not None and (prefetch_budget is None or prefetch_budget > 0):
             self.prefetcher = Prefetcher(

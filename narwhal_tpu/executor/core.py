@@ -85,9 +85,9 @@ class ExecutorCore:
                 output, batches, t_commit = await self.rx_subscriber.recv()
                 await self.execute_certificate(output, batches)
                 if self.metrics is not None and t_commit is not None:
-                    self.metrics.commit_to_exec_latency.observe(
-                        loop.time() - t_commit
-                    )
+                    dt = loop.time() - t_commit
+                    self.metrics.commit_to_exec_latency.observe(dt)
+                    self.metrics.stage_latency.labels("execute").observe(dt)
         except asyncio.CancelledError:
             raise
         except Exception:

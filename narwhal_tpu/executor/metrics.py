@@ -62,3 +62,12 @@ class ExecutorMetrics:
             "Consensus emitting an ordered certificate -> its payload fully "
             "applied to the execution state",
         )
+        # Same quantity under the uniform *_stage_latency_seconds family so
+        # the whole pipeline (seal -> propose -> certify -> commit ->
+        # execute) reads as one labeled histogram set across roles.
+        self.stage_latency = registry.histogram(
+            "executor_stage_latency_seconds",
+            "Per-stage pipeline latency in the executor (stage=execute: "
+            "ordered certificate emitted -> payload fully applied)",
+            labels=("stage",),
+        )

@@ -249,15 +249,29 @@ class GrpcPublicApi:
 class GrpcTransactions:
     """Worker-side client transaction ingest over gRPC
     (Transactions.SubmitTransaction / SubmitTransactionStream), feeding the
-    same batch-maker channel as the typed tx_server."""
+    same batch-maker channel as the typed tx_server — and gated by the same
+    admission control: overload aborts with StatusCode.RESOURCE_EXHAUSTED
+    instead of queueing unboundedly."""
 
-    def __init__(self, tx_batch_maker, metrics=None):
+    def __init__(self, tx_batch_maker, metrics=None, gate=None):
         self.tx_batch_maker = tx_batch_maker
         self.metrics = metrics
+        self.gate = gate  # pacing.IngestGate, shared with the typed ingest
         self._server: grpc.aio.Server | None = None
         self.address: str = ""
 
+    async def _admit(self, context) -> None:
+        if self.gate is None:
+            return
+        from .pacing import IngestOverloadError
+
+        try:
+            await self.gate.admit()
+        except IngestOverloadError as e:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+
     async def _submit(self, request, context):
+        await self._admit(context)
         tx = request.transaction
         frame = len(tx).to_bytes(4, "little") + tx
         if self.metrics is not None:

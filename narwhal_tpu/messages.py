@@ -431,6 +431,34 @@ class DeleteBatchesMsg:
         return DeleteBatchesMsg(tuple(r.seq(_dec_digest)))
 
 
+@message(26)
+@dataclass
+class BackpressureMsg:
+    """Primary -> own workers: the downstream (consensus/executor) backlog
+    level in [0, 1], pushed every backpressure_poll_interval. The worker's
+    ingest gate folds it into admission decisions (pacing.IngestGate) so
+    client-facing ingest sheds/blocks BEFORE the backlog grows unboundedly.
+    Fixed-point basis points on the wire; best-effort delivery — a worker
+    that stops hearing levels fails open (BackpressureState.stale_after)."""
+
+    level_bp: int  # level * 10_000, clamped to [0, 10_000]
+
+    def encode(self, w: Writer) -> None:
+        w.u16(self.level_bp)
+
+    @staticmethod
+    def decode(r: Reader) -> "BackpressureMsg":
+        return BackpressureMsg(r.u16())
+
+    @staticmethod
+    def from_level(level: float) -> "BackpressureMsg":
+        return BackpressureMsg(int(max(0.0, min(1.0, level)) * 10_000))
+
+    @property
+    def level(self) -> float:
+        return self.level_bp / 10_000
+
+
 @message(24)
 @dataclass
 class ReconfigureMsg:

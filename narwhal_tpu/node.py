@@ -285,6 +285,7 @@ class PrimaryNode:
                 protocol = protocol_cls(
                     committee, storage.consensus_store, parameters.gc_depth
                 )
+            self.consensus_metrics = ConsensusMetrics(self.registry)
             self.consensus = Consensus(
                 committee,
                 protocol,
@@ -295,7 +296,7 @@ class PrimaryNode:
                 self.tx_consensus_output,
                 self.primary.tx_reconfigure,
                 parameters.gc_depth,
-                ConsensusMetrics(self.registry),
+                self.consensus_metrics,
                 tx_accepted=self.tx_accepted_certificates,
             )
             self.executor = Executor(
@@ -397,6 +398,12 @@ class PrimaryNode:
             self._tasks.extend(await self.executor.spawn(restored))
         if self.dag is not None:
             self._tasks.append(self.dag.spawn())
+        if self.internal_consensus:
+            # End-to-end admission control: sample the commit/execution
+            # backlog and push the level to our own workers so their
+            # client-facing ingest can shed/block before the backlog grows
+            # without bound (the worker fails open if these pushes stop).
+            self._tasks.append(asyncio.ensure_future(self._backpressure_monitor()))
         # gRPC owns the configured public address (tonic parity); the typed
         # TCP api binds an ephemeral port for in-framework clients.
         self.api.primary_address = self.primary.address
@@ -424,6 +431,71 @@ class PrimaryNode:
                     logger.debug("restart catch-up failed", exc_info=True)
 
             self._tasks.append(asyncio.ensure_future(catch_up()))
+
+    async def _backpressure_monitor(self) -> None:
+        """Executor backlog -> consensus runner -> primary -> worker ingest:
+        the push leg of the admission-control loop. The level folds channel
+        occupancy, the commit-stage latency EWMA vs commit_latency_target,
+        and a commit-stall detector (pacing.backpressure_level — measured
+        overload on this class of host is service-time saturation with
+        shallow channels, so depth alone is blind). Delivery is best-effort
+        unreliable_send every poll interval — workers treat a silent
+        primary as level 0 after backpressure_stale_after (fail open), so
+        this task can die without wedging client ingest."""
+        import time as _time
+
+        from .config import env_float
+        from .messages import BackpressureMsg
+        from .pacing import backpressure_level
+
+        gauge = self.registry.gauge(
+            "node_backpressure_level",
+            "Downstream backlog level pushed to our workers (max of channel "
+            "occupancy, commit-latency-vs-target, and commit-stall signals)",
+        )
+        interval = self.parameters.backpressure_poll_interval
+        target = env_float(
+            "NARWHAL_COMMIT_LATENCY_TARGET", self.parameters.commit_latency_target
+        )
+        channels = [
+            self.tx_new_certificates,
+            self.tx_consensus_output,
+            self.tx_execution_output,
+            # Primary-side saturation: a deep protocol-ingest or
+            # pending-digest queue means the core/proposer can't keep up
+            # even before consensus output backs up.
+            self.primary.tx_primary_messages,
+            self.primary.tx_our_digests,
+        ]
+        if self.executor is not None:
+            channels.append(self.executor.tx_executor)
+        commit_counter = self.consensus_metrics.committed_certificates
+        commit_timer = self.consensus_metrics.commit_timer
+        last_committed = commit_counter.get()
+        last_commit_t = _time.monotonic()
+        while True:
+            committed = commit_counter.get()
+            if committed != last_committed:
+                last_committed, last_commit_t = committed, _time.monotonic()
+            level = backpressure_level(
+                (ch.occupancy() for ch in channels),
+                commit_timer.ewma,
+                (_time.monotonic() - last_commit_t) if committed > 0 else None,
+                target,
+                self.parameters.backpressure_high_watermark,
+            )
+            gauge.set(level)
+            msg = BackpressureMsg.from_level(level)
+            workers = self.worker_cache.our_workers(self.name).values()
+            await asyncio.gather(
+                *(
+                    self.primary.network.unreliable_send(
+                        info.worker_address, msg, timeout=interval
+                    )
+                    for info in workers
+                )
+            )
+            await asyncio.sleep(interval)
 
     async def shutdown(self) -> None:
         for t in self._tasks:

@@ -92,6 +92,11 @@ class Core:
         self.cancel_handlers: dict[Round, list] = {}
         # Channel the certificate waiter listens on; set by the assembly.
         self.tx_certificate_waiter: Channel | None = None
+        # Committee-wide payload sighting hook (set by the assembly to the
+        # proposer's note_payload): a peer's payload-bearing header keeps
+        # OUR round cadence on the pacing floor so the quorum commits it
+        # promptly even when our own worker is idle.
+        self.on_payload_header = None
         # Messages from a FUTURE epoch: our reconfigure notification races
         # the first new-epoch header over different channels, and dropping
         # the loser can deadlock the epoch change (every peer drops every
@@ -132,6 +137,8 @@ class Core:
     # ------------------------------------------------------------------
     async def process_header(self, header: Header) -> None:
         self.processing.setdefault(header.round, set()).add(header.digest)
+        if header.payload and self.on_payload_header is not None:
+            self.on_payload_header()
 
         # Causal completeness: parents must be certified and local
         # (core.rs:200-231). The synchronizer queues repair + loopback.
@@ -222,6 +229,9 @@ class Core:
             )
             if self.metrics is not None:
                 self.metrics.certificates_created.inc()
+                # Stage tracing: the proposer started this clock when it
+                # proposed the header this certificate certifies.
+                self.metrics.certify_timer.stop(certificate.header.digest)
             from ..messages import CertificateMsg, CertificateRefMsg
 
             addresses = [

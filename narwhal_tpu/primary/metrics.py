@@ -3,10 +3,33 @@
 from __future__ import annotations
 
 from ..metrics import Registry
+from ..pacing import StageTimer
 
 
 class PrimaryMetrics:
     def __init__(self, registry: Registry):
+        # -- pacing / stage tracing ----------------------------------------
+        self.stage_latency = registry.histogram(
+            "primary_stage_latency_seconds",
+            "Per-stage pipeline latency on the primary (stage=propose: "
+            "batch digest arrival -> included in a proposed header; "
+            "stage=certify: own header proposed -> certificate assembled)",
+            labels=("stage",),
+        )
+        # Shared timers: the proposer starts them, the proposer (propose)
+        # or the core (certify) stops them. Bounded maps — headers that
+        # never certify and digests dropped on epoch reset age out.
+        self.propose_timer = StageTimer(self.stage_latency, "propose")
+        self.certify_timer = StageTimer(self.stage_latency, "certify")
+        self.effective_header_delay = registry.gauge(
+            "primary_effective_header_delay_seconds",
+            "The adaptive header delay currently in force (floor when "
+            "queues are shallow, max_header_delay under load)",
+        )
+        self.pacing_occupancy = registry.gauge(
+            "primary_pacing_occupancy",
+            "EWMA queue occupancy the proposer pacing controller reads",
+        )
         self.headers_processed = registry.counter(
             "primary_headers_processed", "Headers accepted by the core"
         )

@@ -15,7 +15,7 @@ import asyncio
 import logging
 
 from ..channels import Channel, Watch, drain_cancelled, metered_channel
-from ..config import Committee, Parameters, WorkerCache
+from ..config import Committee, Parameters, WorkerCache, env_float, pacing_enabled
 from ..crypto import SignatureService
 from ..messages import (
     CertificatesBatchRequest,
@@ -148,6 +148,30 @@ class Primary:
             cert_format=getattr(parameters, "cert_format", "full"),
         )
         self.core.tx_certificate_waiter = self.tx_sync_certificates
+        # Adaptive header pacing: the proposer's effective delay tracks the
+        # EWMA occupancy of the digest/ingest/consensus channels between
+        # header_delay_floor and max_header_delay — short rounds when the
+        # pipeline is shallow, full-sized headers at the configured cadence
+        # under load. NARWHAL_PACING=0 pins the ceiling (seed behavior).
+        proposer_pacing = None
+        if pacing_enabled():
+            from ..pacing import PacingController
+
+            proposer_pacing = PacingController(
+                ceiling=parameters.max_header_delay,
+                floor=env_float(
+                    "NARWHAL_HEADER_DELAY_FLOOR", parameters.header_delay_floor
+                ),
+                low_occupancy=parameters.pacing_low_occupancy,
+                high_occupancy=parameters.pacing_high_occupancy,
+                ewma_alpha=parameters.pacing_ewma_alpha,
+                sources=[
+                    self.tx_our_digests.occupancy,
+                    self.tx_primary_messages.occupancy,
+                    self.tx_new_certificates.occupancy,
+                ],
+                gauge=self.metrics.pacing_occupancy,
+            )
         self.proposer = Proposer(
             name,
             committee,
@@ -160,7 +184,12 @@ class Primary:
             self.tx_headers,
             self.tx_reconfigure,
             self.metrics,
+            pacing=proposer_pacing,
         )
+        # A peer's payload-bearing header keeps our proposer on the pacing
+        # floor: round advance is quorum-gated, so the whole committee must
+        # hurry for anyone's payload to commit fast.
+        self.core.on_payload_header = self.proposer.note_payload
         self.header_waiter = HeaderWaiter(
             name,
             committee,

@@ -47,6 +47,7 @@ class Proposer:
         tx_core: Channel,  # our new headers to the core
         rx_reconfigure: Watch,
         metrics=None,
+        pacing=None,  # pacing.PacingController: adaptive header delay
     ):
         self.name = name
         self.committee = committee
@@ -59,12 +60,23 @@ class Proposer:
         self.tx_core = tx_core
         self.rx_reconfigure = Subscriber(rx_reconfigure)
         self.metrics = metrics
+        self.pacing = pacing
 
         self.round: Round = 0
         self.last_parents: list[Certificate] = Certificate.genesis(committee)
         self.last_leader: Certificate | None = None
         self.digests: list[tuple[Digest, WorkerId]] = []
         self.payload_size = 0
+        # When payload was last sighted — our own digests, or (via the
+        # core's note_payload hook) ANY peer's payload-bearing header. Two
+        # reasons this must outlive the payload itself: a committed
+        # transaction needs the NEXT ~2 rounds too (Bullshark commits the
+        # round-r leader once round r+2 exists), and round advance is gated
+        # by a QUORUM of proposers — a node whose own worker saw no
+        # transactions must still hurry while its peers carry payload, or
+        # its idle-ceiling cadence paces the whole committee's commits.
+        self._payload_seen_t = float("-inf")
+        self.payload_grace = max(0.5, 3.0 * max_header_delay)
         self._task: asyncio.Task | None = None
 
     def spawn(self) -> asyncio.Task:
@@ -109,6 +121,8 @@ class Proposer:
 
     # -- header construction ----------------------------------------------
     async def _make_header(self) -> None:
+        if self.digests:
+            self._payload_seen_t = time.monotonic()
         header = Header.build(
             self.name,
             self.round,
@@ -117,6 +131,12 @@ class Proposer:
             {c.digest for c in self.last_parents},
             self.signature_service,
         )
+        if self.metrics is not None:
+            # Stage tracing: digest arrival -> included in a header, and the
+            # certify clock this header's certificate will stop in the core.
+            for digest, _ in self.digests:
+                self.metrics.propose_timer.stop(digest)
+            self.metrics.certify_timer.start(header.digest)
         self.digests.clear()
         self.payload_size = 0
         self.last_parents = []
@@ -134,13 +154,48 @@ class Proposer:
             self.metrics.proposed_headers.inc()
         await self.tx_core.send(header)
 
+    def note_payload(self) -> None:
+        """Committee-wide payload sighting (wired by Primary to the core's
+        header path): a peer's payload-bearing header keeps THIS node's
+        proposer on the floor cadence so the quorum advances rounds fast
+        enough to commit it."""
+        self._payload_seen_t = time.monotonic()
+
+    def _header_delay(self) -> float:
+        """The effective header delay for this loop iteration. With a
+        pacing controller the delay adapts between its floor and
+        max_header_delay on queue occupancy — while payload is pending OR
+        within payload_grace of the last sighting (the rounds that complete
+        the last payload's commit). A genuinely idle proposer keeps the
+        configured ceiling, so an unloaded committee does not spin empty
+        rounds at the floor cadence forever (every round costs a header
+        broadcast plus a quorum of votes)."""
+        payload_active = (
+            bool(self.digests)
+            or not self.rx_workers.empty()
+            or time.monotonic() - self._payload_seen_t < self.payload_grace
+        )
+        if self.pacing is not None and payload_active:
+            delay = self.pacing.delay()
+        else:
+            if self.pacing is not None:
+                self.pacing.observe()  # keep the EWMA live across idle gaps
+            delay = self.max_header_delay
+        if self.metrics is not None:
+            self.metrics.effective_header_delay.set(delay)
+        return delay
+
     async def run(self) -> None:
-        timer_deadline = time.monotonic() + self.max_header_delay
+        last_header_t = time.monotonic()
         parents_task = asyncio.ensure_future(self.rx_core.recv())
         digest_task = asyncio.ensure_future(self.rx_workers.recv())
         recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
         try:
             while True:
+                # Fixed deadline measured from the last proposed header,
+                # recomputed each iteration so pacing changes (queues
+                # draining or filling) take effect mid-round.
+                timer_deadline = last_header_t + self._header_delay()
                 enough_parents = bool(self.last_parents)
                 enough_digests = self.payload_size >= self.header_size
                 timer_expired = time.monotonic() >= timer_deadline
@@ -154,9 +209,15 @@ class Proposer:
                         self.metrics.current_round.set(self.round)
                     logger.debug("Dag moved to round %s", self.round)
                     await self._make_header()
-                    timer_deadline = time.monotonic() + self.max_header_delay
+                    last_header_t = time.monotonic()
+                    timer_deadline = last_header_t + self._header_delay()
 
-                timeout = max(0.0, timer_deadline - time.monotonic())
+                # Past the deadline nothing changes until a message lands:
+                # wait un-timed instead of polling with timeout=0 (with
+                # floor-level delays that poll would busy-yield the loop
+                # for the whole parent-quorum wait).
+                remaining = timer_deadline - time.monotonic()
+                timeout = None if remaining <= 0 else remaining
                 done, _ = await asyncio.wait(
                     {parents_task, digest_task, recon_task},
                     timeout=timeout,
@@ -193,6 +254,8 @@ class Proposer:
                     digest_task = asyncio.ensure_future(self.rx_workers.recv())
                     self.digests.append((digest, worker_id))
                     self.payload_size += len(digest)
+                    if self.metrics is not None:
+                        self.metrics.propose_timer.start(digest)
         finally:
             parents_task.cancel()
             digest_task.cancel()

@@ -31,6 +31,7 @@ class BatchMaker:
         rx_reconfigure: Watch,
         metrics=None,
         benchmark: bool = False,
+        pacing=None,  # pacing.PacingController: adaptive seal delay
     ):
         self.batch_size = batch_size
         self.max_batch_delay = max_batch_delay
@@ -39,20 +40,47 @@ class BatchMaker:
         self.rx_reconfigure = Subscriber(rx_reconfigure)
         self.metrics = metrics
         self.benchmark = benchmark
+        self.pacing = pacing
         # Pending transactions stay in wire form: (frame chunks, tx count).
         self._pending: list[bytes] = []
         self._pending_count = 0
         self._pending_bytes = 0
+        # Arrival of the first chunk since the last seal: the seal-stage
+        # latency sample (worker_stage_latency_seconds{stage="seal"}).
+        self._pending_t0: float | None = None
+        self._seal_stage = (
+            metrics.stage_latency.labels("seal") if metrics is not None else None
+        )
 
     def spawn(self) -> asyncio.Task:
         return asyncio.ensure_future(self.run())
 
+    def _seal_delay(self) -> float:
+        """The effective seal delay for this loop iteration. With a pacing
+        controller the delay adapts between its floor and max_batch_delay on
+        queue occupancy — but only while transactions are pending: an idle
+        batch maker keeps the ceiling (there is nothing whose latency the
+        floor could improve, and the timer with an empty pending set is a
+        no-op anyway)."""
+        if self.pacing is not None and self._pending:
+            delay = self.pacing.delay()
+        else:
+            if self.pacing is not None:
+                self.pacing.observe()  # keep the EWMA live across idle gaps
+            delay = self.max_batch_delay
+        if self.metrics is not None:
+            self.metrics.effective_batch_delay.set(delay)
+        return delay
+
     async def run(self) -> None:
         # Fixed deadline, NOT an idle timeout: the timer runs from the last
         # seal, so a steady sub-batch-size trickle still seals every
-        # max_batch_delay (batch_maker.rs:77-122 uses an interval timer).
-        deadline = time.monotonic() + self.max_batch_delay
+        # effective delay (batch_maker.rs:77-122 uses an interval timer).
+        # The deadline is recomputed from `last_seal` each iteration so a
+        # pacing change (queues draining/filling) takes effect mid-wait.
+        last_seal = time.monotonic()
         while True:
+            deadline = last_seal + self._seal_delay()
             timeout = max(0.0, deadline - time.monotonic())
             try:
                 # Receives whole client bursts as (count, frames) chunks in
@@ -62,18 +90,20 @@ class BatchMaker:
                 )
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
+                if not self._pending:
+                    self._pending_t0 = time.monotonic()
                 self._pending.append(frames)
                 self._pending_count += count
                 self._pending_bytes += len(frames) - 4 * count
                 if self._pending_bytes >= self.batch_size:
                     await self._seal()
-                    deadline = time.monotonic() + self.max_batch_delay
+                    last_seal = time.monotonic()
             except asyncio.TimeoutError:
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
                 if self._pending:
                     await self._seal()
-                deadline = time.monotonic() + self.max_batch_delay
+                last_seal = time.monotonic()
 
     async def _seal(self) -> None:
         serialized = assemble_serialized_batch(self._pending_count, self._pending)
@@ -96,4 +126,7 @@ class BatchMaker:
         if self.metrics is not None:
             self.metrics.created_batch_size.observe(size)
             self.metrics.batches_made.inc()
+        if self._seal_stage is not None and self._pending_t0 is not None:
+            self._seal_stage.observe(time.monotonic() - self._pending_t0)
+        self._pending_t0 = None
         await self.tx_message.send(batch)

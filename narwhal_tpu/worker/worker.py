@@ -18,10 +18,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from ..channels import Channel, Watch, drain_cancelled, metered_channel
-from ..config import Committee, Parameters, WorkerCache
+from ..config import Committee, Parameters, WorkerCache, env_float, pacing_enabled
 from ..messages import (
+    BackpressureMsg,
     CleanupMsg,
     DeleteBatchesMsg,
     DeletedBatchesMsg,
@@ -39,6 +41,7 @@ from ..messages import (
 )
 from ..metrics import Registry
 from ..network import NetworkClient, RpcServer, cached_allow_sets
+from ..pacing import BackpressureState, IngestGate, PacingController
 from ..stores import BatchStore
 from ..types import (
     Batch,
@@ -113,6 +116,52 @@ class Worker:
         self.tx_digest = chan("digest", 10_000)
         self.tx_sync_command = chan("sync_command", 1_000)
 
+        # End-to-end admission control: the primary pushes its downstream
+        # (consensus/executor) backlog level here (BackpressureMsg), and the
+        # client-facing ingest handlers gate on the max of that level and
+        # the local ingest-queue occupancy. Past the high watermark the
+        # gate sheds (RESOURCE_EXHAUSTED) or blocks per ingest_policy, so
+        # overload degrades to bounded latency instead of unbounded backlog.
+        self.backpressure = BackpressureState(
+            high=parameters.backpressure_high_watermark,
+            low=parameters.backpressure_low_watermark,
+            stale_after=parameters.backpressure_stale_after,
+            gauge=self.metrics.backpressure_level,
+        )
+        self.ingest_gate = IngestGate(
+            policy=os.environ.get("NARWHAL_INGEST_POLICY", parameters.ingest_policy),
+            local_sources=[
+                self.tx_batch_maker.occupancy,
+                self.tx_quorum_waiter.occupancy,
+                self.tx_processor.occupancy,
+            ],
+            downstream=self.backpressure,
+            high=parameters.backpressure_high_watermark,
+            low=parameters.backpressure_low_watermark,
+            metrics=self.metrics,
+        )
+        # Adaptive seal pacing: the batch maker's effective delay tracks
+        # the EWMA occupancy of the batch pipeline's channels between
+        # batch_delay_floor and max_batch_delay. NARWHAL_PACING=0 pins the
+        # configured ceiling (the fixed-timer seed behavior).
+        self.batch_pacing: PacingController | None = None
+        if pacing_enabled():
+            self.batch_pacing = PacingController(
+                ceiling=parameters.max_batch_delay,
+                floor=env_float(
+                    "NARWHAL_BATCH_DELAY_FLOOR", parameters.batch_delay_floor
+                ),
+                low_occupancy=parameters.pacing_low_occupancy,
+                high_occupancy=parameters.pacing_high_occupancy,
+                ewma_alpha=parameters.pacing_ewma_alpha,
+                sources=[
+                    self.tx_batch_maker.occupancy,
+                    self.tx_quorum_waiter.occupancy,
+                    self.tx_processor.occupancy,
+                ],
+                gauge=self.metrics.pacing_occupancy,
+            )
+
     async def spawn(self) -> None:
         me = self.worker_cache.worker(self.name, self.worker_id)
         host, port = me.worker_address.rsplit(":", 1)
@@ -126,7 +175,9 @@ class Worker:
         # ingest; ephemeral port, surfaced via grpc_transactions_address.
         from ..grpc_api import GrpcTransactions
 
-        self.grpc_transactions = GrpcTransactions(self.tx_batch_maker, self.metrics)
+        self.grpc_transactions = GrpcTransactions(
+            self.tx_batch_maker, self.metrics, gate=self.ingest_gate
+        )
         self.grpc_transactions_address = await self.grpc_transactions.spawn(
             f"{thost}:0"
         )
@@ -154,6 +205,9 @@ class Worker:
             DeleteBatchesMsg, self._on_delete_batches, allow=allow_own_primary
         )
         self.server.route(ReconfigureMsg, self._on_reconfigure, allow=allow_own_primary)
+        self.server.route(
+            BackpressureMsg, self._on_backpressure, allow=allow_own_primary
+        )
         self.tx_server.route(SubmitTransactionMsg, self._on_tx)
         self.tx_server.route(SubmitTransactionStreamMsg, self._on_tx_stream)
 
@@ -168,6 +222,7 @@ class Worker:
                 self.rx_reconfigure,
                 self.metrics,
                 self.benchmark,
+                pacing=self.batch_pacing,
             ).spawn(),
             QuorumWaiter(
                 self.name,
@@ -294,7 +349,15 @@ class Worker:
         self.rx_reconfigure.send(ReconfigureNotification(msg.kind, committee))
         return None
 
+    async def _on_backpressure(self, msg: BackpressureMsg, peer: str):
+        self.backpressure.update(msg.level)
+        return None
+
     async def _on_tx(self, msg: SubmitTransactionMsg, peer: str):
+        # Admission control first: shedding raises IngestOverloadError,
+        # which the RPC server surfaces to the client as an ERR frame whose
+        # text carries the RESOURCE_EXHAUSTED prefix verbatim.
+        await self.ingest_gate.admit()
         self.metrics.tx_received.inc()
         tx = msg.transaction
         frame = len(tx).to_bytes(4, "little") + tx
@@ -308,6 +371,7 @@ class Worker:
         count = msg.count
         if count == 0:
             return None  # empty submission: no-op, never an empty batch
+        await self.ingest_gate.admit()
         frames = msg.frames
         validate_tx_frames(frames, count)
         self.metrics.tx_received.inc(count)

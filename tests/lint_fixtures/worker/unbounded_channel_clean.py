@@ -1,0 +1,18 @@
+"""Clean fixture for no-unbounded-channel: every edge has a deliberate
+capacity (positional or keyword), metered or not — and non-Channel calls
+never match."""
+
+from narwhal_tpu.channels import Channel, metered_channel
+
+
+class NotAChannel:
+    def Channel(self):  # method named Channel on another receiver
+        return None
+
+
+def build_edges(registry, gauge):
+    a = Channel(1_000)  # positional capacity
+    b = Channel(capacity=50, gauge=gauge)  # keyword capacity
+    c = metered_channel(registry, "worker", "edge", 10_000)  # the wrapper
+    d = NotAChannel().Channel()  # not the channels.Channel constructor
+    return a, b, c, d

@@ -1,0 +1,12 @@
+"""Tripping fixture for no-unbounded-channel: Channel constructed without an
+explicit capacity in a scoped dir (3 findings pinned)."""
+
+from narwhal_tpu.channels import Channel
+from narwhal_tpu import channels
+
+
+def build_edges(gauge):
+    a = Channel()  # bare default capacity
+    b = Channel(gauge=gauge)  # keyword-only, still the default capacity
+    c = channels.Channel()  # attribute-form constructor
+    return a, b, c

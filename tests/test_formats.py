@@ -84,6 +84,7 @@ def _golden_messages():
         M.RequestBatchMsg: M.RequestBatchMsg(d1),
         M.RequestBatchesMsg: M.RequestBatchesMsg((d1, d2)),
         M.DeleteBatchesMsg: M.DeleteBatchesMsg((d1, d2)),
+        M.BackpressureMsg: M.BackpressureMsg.from_level(0.75),
         M.ReconfigureMsg: M.ReconfigureMsg("new_epoch", "{}"),
         M.OurBatchMsg: M.OurBatchMsg(d1, 0),
         M.OthersBatchMsg: M.OthersBatchMsg(d2, 1),

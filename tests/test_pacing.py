@@ -1,0 +1,541 @@
+"""Adaptive pacing, end-to-end admission control, and per-stage latency
+tracing (ISSUE 6).
+
+Covers the pacing controller's response curve (shallow-queue floor,
+deep-queue ceiling, monotonicity), the backpressure state's hysteresis and
+staleness fail-open, bounded-vs-unbounded backlog with admission control on
+vs off, the on-the-wire RESOURCE_EXHAUSTED shed through a real Worker, the
+BatchMaker's fixed-deadline (non-idle-timeout) seal semantics, and a cluster
+smoke test asserting the whole *_stage_latency_seconds pipeline records.
+"""
+
+import asyncio
+import time
+from dataclasses import replace
+
+import pytest
+
+from narwhal_tpu.channels import Channel, Watch
+from narwhal_tpu.metrics import Registry
+from narwhal_tpu.pacing import (
+    BackpressureState,
+    IngestGate,
+    IngestOverloadError,
+    PacingController,
+    StageTimer,
+)
+from narwhal_tpu.types import ReconfigureNotification
+from narwhal_tpu.worker.batch_maker import BatchMaker
+
+
+def _watch():
+    return Watch(ReconfigureNotification("boot"))
+
+
+def _chunk(*txs: bytes) -> tuple[int, bytes]:
+    return len(txs), b"".join(len(t).to_bytes(4, "little") + t for t in txs)
+
+
+# ---------------------------------------------------------------------------
+# PacingController
+# ---------------------------------------------------------------------------
+
+
+def _controller(**kw):
+    kw.setdefault("ceiling", 0.1)
+    kw.setdefault("floor", 0.005)
+    return PacingController(**kw)
+
+
+def test_pacing_shallow_queue_fast_seal():
+    """Occupancy at/under the low band -> the delay is the floor."""
+    c = _controller(sources=[lambda: 0.0])
+    for _ in range(10):
+        assert c.delay() == pytest.approx(0.005)
+
+
+def test_pacing_deep_queue_ceiling():
+    """Occupancy at/over the high band -> the delay is the ceiling."""
+    c = _controller(sources=[lambda: 1.0])
+    for _ in range(50):  # let the EWMA converge
+        d = c.delay()
+    assert d == pytest.approx(0.1)
+
+
+def test_pacing_monotone_response():
+    """The delay is non-decreasing in occupancy over the whole range."""
+    delays = []
+    for occ in [i / 20 for i in range(21)]:
+        # alpha=1 disables smoothing so this reads the pure response curve.
+        c = _controller(ewma_alpha=1.0, sources=[lambda o=occ: o])
+        delays.append(c.delay())
+    assert delays == sorted(delays)
+    assert delays[0] == pytest.approx(0.005)
+    assert delays[-1] == pytest.approx(0.1)
+
+
+def test_pacing_ewma_smooths_bursts():
+    """One empty sample after a long full stretch must not drop the delay
+    to the floor (sawtooth occupancy would otherwise flap modes)."""
+    c = _controller(sources=[lambda: 1.0])
+    for _ in range(50):
+        c.delay()
+    c._sources = [lambda: 0.0]
+    assert c.delay() > 0.05  # still near ceiling after one shallow sample
+
+
+def test_pacing_ceiling_under_floor_honors_operator():
+    """max_*_delay configured below the adaptive floor wins verbatim."""
+    c = PacingController(ceiling=0.001, floor=0.05, sources=[lambda: 0.0])
+    assert c.delay() == pytest.approx(0.001)
+    c2 = PacingController(ceiling=0.001, floor=0.05, sources=[lambda: 1.0])
+    for _ in range(50):
+        d = c2.delay()
+    assert d == pytest.approx(0.001)
+
+
+# ---------------------------------------------------------------------------
+# BackpressureState / IngestGate
+# ---------------------------------------------------------------------------
+
+
+def test_backpressure_hysteresis():
+    now = [0.0]
+    s = BackpressureState(high=0.8, low=0.5, stale_after=60.0, clock=lambda: now[0])
+    assert not s.overloaded()
+    s.update(0.85)
+    assert s.overloaded()
+    s.update(0.7)  # between low and high: stays tripped
+    assert s.overloaded()
+    s.update(0.4)  # below low: releases
+    assert not s.overloaded()
+    s.update(0.7)  # between bands from below: stays released
+    assert not s.overloaded()
+
+
+def test_backpressure_stale_fails_open():
+    """A worker that stops hearing its primary must not shed forever."""
+    now = [0.0]
+    s = BackpressureState(high=0.8, low=0.5, stale_after=2.0, clock=lambda: now[0])
+    s.update(1.0)
+    assert s.level() == 1.0 and s.overloaded()
+    now[0] = 3.0  # past stale_after with no update
+    assert s.level() == 0.0
+    assert not s.overloaded()
+
+
+def test_ingest_gate_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        IngestGate(policy="bogus")
+
+
+def test_ingest_gate_shed_and_readmit(run):
+    level = [0.0]
+    gate = IngestGate(policy="shed", local_sources=[lambda: level[0]], high=0.8, low=0.5)
+
+    async def scenario():
+        await gate.admit()  # empty: admits
+        level[0] = 0.9
+        with pytest.raises(IngestOverloadError) as ei:
+            await gate.admit()
+        assert "RESOURCE_EXHAUSTED" in str(ei.value)
+        level[0] = 0.7  # hysteresis: still tripped between the bands
+        with pytest.raises(IngestOverloadError):
+            await gate.admit()
+        level[0] = 0.3
+        await gate.admit()  # released
+
+    run(scenario())
+
+
+def test_ingest_gate_block_policy(run):
+    level = [1.0]
+    gate = IngestGate(
+        policy="block", local_sources=[lambda: level[0]],
+        high=0.8, low=0.5, block_timeout=5.0, block_poll=0.01,
+    )
+
+    async def scenario():
+        async def release():
+            await asyncio.sleep(0.1)
+            level[0] = 0.0
+
+        rel = asyncio.ensure_future(release())
+        t0 = time.monotonic()
+        await gate.admit()  # blocks until the level falls, then admits
+        assert 0.05 < time.monotonic() - t0 < 2.0
+        await rel
+        # And with the level pinned high, the bounded block sheds.
+        level[0] = 1.0
+        gate.block_timeout = 0.1
+        with pytest.raises(IngestOverloadError):
+            await gate.admit()
+
+    run(scenario())
+
+
+def test_admission_bounds_backlog_gate_on_vs_off(run):
+    """The overload claim at component level: a producer pushing far past
+    capacity leaves a BOUNDED queue behind the gate (sheds past the high
+    watermark) and an unbounded-growth queue without it (policy off)."""
+
+    async def scenario():
+        async def offer(gate: IngestGate, ch: Channel, n: int) -> int:
+            accepted = 0
+            for i in range(n):
+                try:
+                    await gate.admit()
+                except IngestOverloadError:
+                    continue
+                ch.try_send(i)
+                accepted += 1
+            return accepted
+
+        cap = 1_000
+        ch_on: Channel = Channel(cap)
+        gate_on = IngestGate(
+            policy="shed", local_sources=[ch_on.occupancy], high=0.05, low=0.02
+        )
+        accepted = await offer(gate_on, ch_on, 500)
+        # Trips at 5% occupancy (50 items) and, with nothing draining,
+        # never re-admits: the backlog is bounded at the watermark.
+        assert ch_on.depth() <= int(0.05 * cap) + 1
+        assert accepted == ch_on.depth()
+
+        ch_off: Channel = Channel(cap)
+        gate_off = IngestGate(
+            policy="off", local_sources=[ch_off.occupancy], high=0.05, low=0.02
+        )
+        await offer(gate_off, ch_off, 500)
+        # Same offered load, no admission control: backlog grows with the
+        # offered load, sailing far past the watermark.
+        assert ch_off.depth() == 500
+
+    run(scenario())
+
+
+def test_backpressure_level_folds_three_signals():
+    """The pushed level sees depth, service-time saturation, and collapse:
+    shallow channels + slow commits must still trip the watermark (the
+    measured 1-core overload mode), and a full commit stall pins 1.0."""
+    from narwhal_tpu.pacing import backpressure_level
+
+    # Healthy: shallow queues, fast commits.
+    assert backpressure_level([0.01, 0.0], 0.2, 0.3, 4.0, 0.75) < 0.1
+    # Deep queue alone trips (executor lagging consensus).
+    assert backpressure_level([0.9], 0.2, 0.3, 4.0, 0.75) == pytest.approx(0.9)
+    # Service-time saturation: channels shallow, commit EWMA at the target
+    # -> exactly the high watermark; over the target -> above it.
+    assert backpressure_level([0.01], 4.0, 0.3, 4.0, 0.75) == pytest.approx(0.75)
+    assert backpressure_level([0.01], 8.0, 0.3, 4.0, 0.75) == 1.0
+    # Collapse: no commit for longer than the target pins 1.0 even with no
+    # EWMA to read.
+    assert backpressure_level([0.0], None, 10.0, 4.0, 0.75) == 1.0
+    # target=0 disables the latency signals entirely.
+    assert backpressure_level([0.1], 100.0, 100.0, 0.0, 0.75) == pytest.approx(0.1)
+
+
+def test_stage_timer_ewma_tracks_recent():
+    reg = Registry()
+    hist = reg.histogram("e_stage_latency_seconds", "", labels=("stage",))
+    t = StageTimer(hist, "commit", ewma_alpha=0.5)
+    assert t.ewma is None
+    t.observe(1.0)
+    assert t.ewma == pytest.approx(1.0)
+    t.observe(3.0)
+    assert t.ewma == pytest.approx(2.0)  # recent-weighted, not lifetime mean
+
+
+# ---------------------------------------------------------------------------
+# Worker ingest: the RESOURCE_EXHAUSTED shed on the wire
+# ---------------------------------------------------------------------------
+
+
+def test_worker_sheds_on_downstream_backpressure(run):
+    """BackpressureMsg(level high) -> typed submissions answer
+    RESOURCE_EXHAUSTED; level low -> admission resumes. The full wire path:
+    client -> RpcServer -> gate -> ERR frame."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.messages import BackpressureMsg, SubmitTransactionMsg
+    from narwhal_tpu.network import NetworkClient, RpcError
+    from narwhal_tpu.stores import NodeStorage
+    from narwhal_tpu.worker import Worker
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        w = Worker(
+            f.authorities[0].public, 0, f.committee, f.worker_cache,
+            f.parameters, NodeStorage(None).batch_store,
+        )
+        await w.spawn()
+        client = NetworkClient()
+        try:
+            await client.request(w.transactions_address, SubmitTransactionMsg(b"ok-1"))
+
+            await client.request(
+                w.worker_address, BackpressureMsg.from_level(1.0)
+            )
+            assert w.backpressure.level() == pytest.approx(1.0)
+            with pytest.raises(RpcError) as ei:
+                await client.request(
+                    w.transactions_address, SubmitTransactionMsg(b"shed-me")
+                )
+            assert "RESOURCE_EXHAUSTED" in str(ei.value)
+            assert w.registry.value("worker_ingest_shed") >= 1
+
+            await client.request(
+                w.worker_address, BackpressureMsg.from_level(0.0)
+            )
+            await client.request(w.transactions_address, SubmitTransactionMsg(b"ok-2"))
+        finally:
+            client.close()
+            await w.shutdown()
+
+    run(scenario())
+
+
+def test_worker_ingest_policy_off_keeps_seed_behavior(run):
+    """ingest_policy=off: even a pinned-high downstream level never sheds
+    (the documented escape hatch back to unbounded queueing)."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.messages import BackpressureMsg, SubmitTransactionMsg
+    from narwhal_tpu.network import NetworkClient
+    from narwhal_tpu.stores import NodeStorage
+    from narwhal_tpu.worker import Worker
+
+    async def scenario():
+        f = CommitteeFixture(size=4, workers=1)
+        params = replace(f.parameters, ingest_policy="off")
+        w = Worker(
+            f.authorities[0].public, 0, f.committee, f.worker_cache,
+            params, NodeStorage(None).batch_store,
+        )
+        await w.spawn()
+        client = NetworkClient()
+        try:
+            await client.request(w.worker_address, BackpressureMsg.from_level(1.0))
+            for i in range(5):
+                await client.request(
+                    w.transactions_address, SubmitTransactionMsg(bytes([i]) * 16)
+                )
+            assert w.registry.value("worker_ingest_shed") == 0
+        finally:
+            client.close()
+            await w.shutdown()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# BatchMaker: seal semantics under fixed and adaptive delays
+# ---------------------------------------------------------------------------
+
+
+def test_batch_maker_trickle_seals_at_fixed_deadline(run):
+    """The seal timer is a FIXED deadline measured from the last seal, not
+    an idle timeout: a steady sub-batch-size trickle arriving faster than
+    the delay still seals every max_batch_delay (an idle-timeout reset on
+    each arrival would never seal)."""
+
+    async def scenario():
+        rx, tx_out = Channel(1_000), Channel(100)
+        bm = BatchMaker(1_000_000, 0.08, rx, tx_out, _watch())  # no pacing
+        task = bm.spawn()
+
+        async def trickle():
+            for i in range(25):  # one tx every 20ms for 0.5s
+                await rx.send(_chunk(b"t%02d" % i))
+                await asyncio.sleep(0.02)
+
+        await trickle()
+        await asyncio.sleep(0.1)  # let the final window seal
+        task.cancel()
+        batches = []
+        while True:
+            b = tx_out.try_recv()
+            if b is None:
+                break
+            batches.append(b)
+        # ~0.5s of trickle at an 0.08s deadline: expect ~6 seals; at least
+        # 3 proves the deadline fires regardless of arrivals, and multiple
+        # txs per batch proves the deadline did NOT reset per arrival.
+        assert len(batches) >= 3
+        assert sum(len(b.transactions) for b in batches) == 25
+        assert max(len(b.transactions) for b in batches) >= 2
+
+    run(scenario())
+
+
+def test_batch_maker_adaptive_seals_near_floor(run):
+    """With a pacing controller and shallow queues, a lone transaction
+    seals near the floor instead of waiting out the configured ceiling."""
+
+    async def scenario():
+        rx, tx_out = Channel(1_000), Channel(100)
+        pacing = PacingController(
+            ceiling=5.0, floor=0.005, sources=[rx.occupancy, tx_out.occupancy]
+        )
+        bm = BatchMaker(1_000_000, 5.0, rx, tx_out, _watch(), pacing=pacing)
+        task = bm.spawn()
+        t0 = time.monotonic()
+        await rx.send(_chunk(b"lonely"))
+        batch = await asyncio.wait_for(tx_out.recv(), 1.0)  # << the 5s ceiling
+        assert time.monotonic() - t0 < 1.0
+        assert batch.transactions == (b"lonely",)
+        task.cancel()
+
+    run(scenario())
+
+
+def test_batch_maker_deep_queue_keeps_ceiling(run):
+    """With the EWMA pinned at full occupancy the effective delay is the
+    ceiling — throughput mode accumulates instead of sealing greedily."""
+
+    async def scenario():
+        rx, tx_out = Channel(1_000), Channel(100)
+        pacing = PacingController(ceiling=0.3, floor=0.001, sources=[lambda: 1.0])
+        for _ in range(50):
+            pacing.observe()  # converge the EWMA to saturated
+        bm = BatchMaker(1_000_000, 0.3, rx, tx_out, _watch(), pacing=pacing)
+        task = bm.spawn()
+        await rx.send(_chunk(b"tx-a"))
+        await asyncio.sleep(0.05)
+        assert tx_out.try_recv() is None  # not sealed at the floor cadence
+        batch = await asyncio.wait_for(tx_out.recv(), 2.0)  # ceiling seal
+        assert batch.transactions == (b"tx-a",)
+        task.cancel()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# StageTimer
+# ---------------------------------------------------------------------------
+
+
+def test_stage_timer_records_and_bounds():
+    reg = Registry()
+    hist = reg.histogram("t_stage_latency_seconds", "", labels=("stage",))
+    now = [100.0]
+    t = StageTimer(hist, "commit", max_pending=4, clock=lambda: now[0])
+    t.start("a")
+    now[0] = 100.25
+    assert t.stop("a") == pytest.approx(0.25)
+    assert reg.value("t_stage_latency_seconds", "commit") == 1
+    assert t.stop("a") is None  # idempotent
+    # Re-delivery must not reset the clock.
+    t.start("b")
+    now[0] = 101.0
+    t.start("b")
+    assert t.stop("b") == pytest.approx(0.75)
+    # The pending map is bounded: oldest keys evict, never-stopped keys
+    # cannot leak.
+    for k in range(10):
+        t.start(k)
+    assert len(t._pending) <= 4
+    assert t.stop(0) is None  # evicted
+    assert t.stop(9) is not None
+
+
+# ---------------------------------------------------------------------------
+# Cluster: kwargs satellite + the stage pipeline end to end
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_delay_kwargs_override():
+    from narwhal_tpu.cluster import Cluster
+
+    c = Cluster(size=4, max_header_delay=0.123, max_batch_delay=0.456)
+    assert c.parameters.max_header_delay == pytest.approx(0.123)
+    assert c.parameters.max_batch_delay == pytest.approx(0.456)
+    # An explicit Parameters still wins outright.
+    from narwhal_tpu.config import Parameters
+
+    p = Parameters(max_header_delay=0.9)
+    c2 = Cluster(size=4, parameters=p, max_header_delay=0.1)
+    assert c2.parameters.max_header_delay == pytest.approx(0.9)
+
+
+def test_stage_latency_pipeline_end_to_end(run):
+    """Boot a committee, push transactions through to execution, and assert
+    every stage histogram recorded: worker seal, primary propose+certify,
+    consensus commit, executor execute — the decomposable latency plane the
+    17-second opaque p50 turns into. Also proves the primary's
+    backpressure push reaches its workers."""
+    from narwhal_tpu.cluster import Cluster
+    from narwhal_tpu.messages import SubmitTransactionStreamMsg
+    from narwhal_tpu.network import NetworkClient
+
+    async def scenario():
+        cluster = Cluster(size=4, workers=1)
+        await cluster.start()
+        client = NetworkClient()
+        try:
+            await cluster.assert_progress(commit_threshold=2, timeout=30.0)
+            txs = tuple(
+                b"\x01" + i.to_bytes(8, "big") + b"\x5a" * 55 for i in range(64)
+            )
+            await client.request(
+                cluster.authorities[0].worker_transactions_address(0),
+                SubmitTransactionStreamMsg(txs),
+            )
+            # Wait until node 0 executes payload (the full pipeline ran).
+            out = cluster.authorities[0].primary.tx_execution_output
+            await asyncio.wait_for(out.recv(), 30.0)
+
+            deadline = asyncio.get_event_loop().time() + 30.0
+            def stages(a):
+                r = a.primary.registry
+                wr = cluster.authorities[0].workers[0].registry
+                return {
+                    "seal": wr.value("worker_stage_latency_seconds", "seal"),
+                    "propose": r.value("primary_stage_latency_seconds", "propose"),
+                    "certify": r.value("primary_stage_latency_seconds", "certify"),
+                    "commit": r.value("consensus_stage_latency_seconds", "commit"),
+                    "execute": r.value("executor_stage_latency_seconds", "execute"),
+                }
+
+            a0 = cluster.authorities[0]
+            while True:
+                counts = stages(a0)
+                if all(v > 0 for v in counts.values()):
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(f"stage histograms incomplete: {counts}")
+                await asyncio.sleep(0.2)
+
+            # The admission-control push leg is alive: the worker heard a
+            # fresh level from its primary within the staleness window.
+            bp = a0.workers[0].worker.backpressure
+            assert (
+                time.monotonic() - bp._updated_at
+                < cluster.parameters.backpressure_stale_after
+            )
+        finally:
+            client.close()
+            await cluster.shutdown()
+
+    run(scenario(), timeout=90.0)
+
+
+def test_pacing_env_kill_switch(monkeypatch):
+    """NARWHAL_PACING=0 pins the fixed-timer seed behavior: no controllers
+    are constructed anywhere in the worker."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.stores import NodeStorage
+    from narwhal_tpu.worker import Worker
+
+    f = CommitteeFixture(size=4, workers=1)
+    monkeypatch.setenv("NARWHAL_PACING", "0")
+    w = Worker(
+        f.authorities[0].public, 0, f.committee, f.worker_cache,
+        f.parameters, NodeStorage(None).batch_store,
+    )
+    assert w.batch_pacing is None
+    monkeypatch.delenv("NARWHAL_PACING")
+    w2 = Worker(
+        f.authorities[0].public, 0, f.committee, f.worker_cache,
+        f.parameters, NodeStorage(None).batch_store,
+    )
+    assert w2.batch_pacing is not None
+    assert w2.batch_pacing.ceiling == pytest.approx(f.parameters.max_batch_delay)

@@ -82,6 +82,7 @@ EXPECTED_RULES = {
     "no-silent-except",
     "no-sync-store-write-in-async",
     "no-per-item-rpc-in-loop",
+    "no-unbounded-channel",
 }
 
 FIXTURE_FOR = {
@@ -104,6 +105,10 @@ FIXTURE_FOR = {
     "no-per-item-rpc-in-loop": (
         "executor/per_item_rpc_trip.py",
         "executor/per_item_rpc_clean.py",
+    ),
+    "no-unbounded-channel": (
+        "worker/unbounded_channel_trip.py",
+        "worker/unbounded_channel_clean.py",
     ),
 }
 
@@ -144,6 +149,7 @@ def test_fixture_finding_counts():
         "no-silent-except": 2,  # pass-only swallow, broad unlogged catch
         "no-sync-store-write-in-async": 4,  # store write/put, engine batch, bare store
         "no-per-item-rpc-in-loop": 3,  # for+attr recv, async for, bare name
+        "no-unbounded-channel": 3,  # bare, keyword-only gauge, attr form
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -9,6 +9,7 @@
 | no-shared-decode-mutation | the ADVICE r5 medium: decode-cache corruption     |
 | no-silent-except          | swallowed failures in the consensus-critical dirs |
 | no-per-item-rpc-in-loop   | RTT x items serialization on the commit data plane|
+| no-unbounded-channel      | default-capacity edges defeating admission control|
 
 Rules are pure `ast` visitors over one `Module` at a time; registration is
 import-time via the `@register` decorator so `RULES` is the single catalog
@@ -731,6 +732,53 @@ class NoPerItemRpcInLoop(Rule):
                 continue
             yield node
             stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# no-unbounded-channel
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoUnboundedChannel(Rule):
+    name = "no-unbounded-channel"
+    summary = (
+        "in worker/, primary/ and executor/ hot paths, a Channel "
+        "constructed without an explicit capacity silently takes the "
+        "1000-item default — an edge nobody sized, invisible to the "
+        "occupancy watermarks the pacing controller and admission gate "
+        "read; pass a deliberate capacity (or use metered_channel)"
+    )
+
+    _SCOPED_DIRS = frozenset({"worker", "primary", "executor"})
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, aliases)
+            if target is None or not (
+                target == "Channel" or target.endswith(".Channel")
+            ):
+                continue
+            # The first positional argument is the capacity; a capacity=
+            # keyword also counts. Anything else (bare Channel(), or only
+            # gauge=/other keywords) ships the unexamined default.
+            if node.args:
+                continue
+            if any(kw.arg == "capacity" for kw in node.keywords):
+                continue
+            yield self.finding(
+                mod,
+                node,
+                f"`{target}(...)` without an explicit capacity takes the "
+                "default bound on a hot-path actor edge — size it "
+                "deliberately so channel occupancy means something to the "
+                "pacing/backpressure watermarks",
+            )
 
 
 # ---------------------------------------------------------------------------
