@@ -352,7 +352,18 @@ class PeerClient:
             except Exception:  # lint: allow(no-silent-except)
                 pass  # best-effort close of an already-failed transport
         self._writer = None
-        self._reader_task = None
+        # Cancel the read loop unless teardown IS the read loop's own
+        # finally: on a half-open transport (peer gone silently, no EOF
+        # delivered) the reader would otherwise survive close() parked in
+        # _read_frame forever — the dropped-handle shutdown-wedge class.
+        reader_task, self._reader_task = self._reader_task, None
+        if reader_task is not None and not reader_task.done():
+            try:
+                current = asyncio.current_task()
+            except RuntimeError:
+                current = None
+            if reader_task is not current:
+                reader_task.cancel()
         self._session = None
         pending, self._pending = self._pending, {}
         for fut in pending.values():

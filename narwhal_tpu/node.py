@@ -504,6 +504,11 @@ class PrimaryNode:
         await self.api.shutdown()
         await self.grpc_api.shutdown()
         await self.primary.shutdown()
+        if self.crypto_pool is not None:
+            # AsyncVerifierPool drains its in-flight batch tasks; the
+            # process-shared VerifyService makes this a deliberate no-op
+            # (other co-hosted nodes keep using it).
+            await self.crypto_pool.close()
         self.storage.close()
 
 

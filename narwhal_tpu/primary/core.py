@@ -289,6 +289,15 @@ class Core:
         )
         parents = aggregator.append(certificate, self.committee)
         if parents is not None:
+            # Wait-cycle with the proposer (core -> tx_parents -> proposer
+            # -> tx_headers -> core), justified: the protocol itself bounds
+            # the in-flight count far below either capacity — the
+            # aggregator emits at most ONE parent set per round, the
+            # proposer at most one header per round, and neither side can
+            # advance a round until the other consumed the previous item
+            # (round advance is parent-quorum-gated). narwhal-topo flags
+            # the shape; this argument is why it cannot fill.
+            # lint: allow(bounded-channel-cycle)
             await self.tx_proposer.send(
                 (parents, certificate.round, certificate.epoch)
             )

@@ -1167,4 +1167,13 @@ class AsyncVerifierPool:
     async def close(self) -> None:
         if self._flusher is not None:
             self._flusher.cancel()
+            self._flusher = None
         self._flush_now()
+        # In-flight batch dispatches resolve their callers' futures; give
+        # them a bounded window to finish, then cancel stragglers so no
+        # batch task survives its owner (a wedged executor thread must not
+        # hang node shutdown or leak tasks into the next test).
+        if self._batches:
+            _, stuck = await asyncio.wait(set(self._batches), timeout=5.0)
+            for t in stuck:
+                t.cancel()

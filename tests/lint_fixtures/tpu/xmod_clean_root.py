@@ -1,0 +1,11 @@
+"""Cross-module jit-purity clean fixture: the jitted root only reaches
+the pure sibling helper — no findings in either module."""
+
+import jax
+
+from .xmod_helper import clean_helper
+
+
+@jax.jit
+def pure_kernel(x):
+    return clean_helper(x) + 1

@@ -1,0 +1,22 @@
+"""Sibling-module helper for the cross-module jit-purity fixtures: two
+impurities reachable ONLY through xmod_root's jitted kernel, plus one
+carrying a justified inline allow (must not be reported)."""
+
+import time
+
+
+def helper(x):
+    print("debug", x)  # impure 1: trace-time print, elided from the kernel
+    t = time.time()  # impure 2: trace-time constant baked into the kernel
+    return x + t
+
+
+def warmed(x):
+    # compile-time wall-clock log, deliberate: runs once per trace
+    # lint: allow(jit-purity)
+    t0 = time.perf_counter()
+    return x, t0
+
+
+def clean_helper(x):
+    return x * 2

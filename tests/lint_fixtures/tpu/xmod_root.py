@@ -1,0 +1,15 @@
+"""Cross-module jit-purity tripping fixture: the jitted root is pure in
+THIS module, but it calls into xmod_helper — whose impurities the old
+same-module BFS could never see. Scanning this file must report the two
+unsuppressed impure sites over in xmod_helper.py."""
+
+import jax
+
+from .xmod_helper import clean_helper, helper, warmed
+
+
+@jax.jit
+def kernel(x):
+    y = helper(x)
+    z = warmed(y)
+    return clean_helper(z)

@@ -1,12 +1,21 @@
-"""narwhal-lint: the tier-1 static-analysis gate plus per-rule fixtures.
+"""The tier-1 static-analysis gates: narwhal-lint AND narwhal-topo.
 
-The gate test runs the analyzer over `narwhal_tpu/` and `tests/` and fails
-on any non-baselined finding — this is how the actor/JAX invariants
-(metered channels, non-blocking event loop, drainable task spawns, jit
-purity, immutable decoded messages, no silent excepts) stay machine-checked
-after this PR. Fixture tests pin each rule to one tripping and one clean
-snippet so a rule regression (stops firing / starts overfiring) is caught
-in the same run.
+Part 1 (narwhal-lint): runs the per-function analyzer over `narwhal_tpu/`
+and `tests/` and fails on any non-baselined finding — this is how the
+actor/JAX invariants (metered channels, non-blocking event loop,
+drainable task spawns, jit purity, immutable decoded messages, no silent
+excepts) stay machine-checked. Fixture tests pin each rule to one
+tripping and one clean snippet so a rule regression (stops firing /
+starts overfiring) is caught in the same run.
+
+Part 2 (narwhal-topo, tools/analysis): the whole-program gate — extracts
+the actor/channel topology from the wiring roots and fails on orphan
+producers/consumers, bounded-channel deadlock cycles, dropped task
+handles, wire-schema drift, and cross-module jit impurity. The extracted
+topology is pinned as a checked-in artifact (tools/analysis/topology.json
++ .dot): wiring changes without `python -m tools.analysis
+--write-artifact` fail the stale-artifact test, exactly like a stale lint
+baseline.
 """
 
 from __future__ import annotations
@@ -287,3 +296,321 @@ def test_fixture_dir_is_excluded_from_directory_walks():
     assert not any("lint_fixtures" in f.path for f in result.new)
     explicit = lint(FIXTURES / "raw_queue_trip.py")
     assert explicit.new
+
+
+# ---------------------------------------------------------------------------
+# Cross-module jit-purity (the retired same-module caveat)
+# ---------------------------------------------------------------------------
+
+
+def test_jit_purity_reports_cross_module_impurities():
+    """Scanning the module that DECLARES the jit root must surface impure
+    sites reached in sibling modules, anchored at their real location."""
+    result = lint(
+        FIXTURES / "tpu" / "xmod_root.py", rules={"jit-purity": RULES["jit-purity"]}
+    )
+    assert len(result.new) == 2, [(f.path, f.line, f.message) for f in result.new]
+    assert all(f.path.endswith("xmod_helper.py") for f in result.new)
+    kinds = " ".join(f.message for f in result.new)
+    assert "print" in kinds and "time.time" in kinds
+
+
+def test_jit_purity_cross_module_respects_inline_allow():
+    """xmod_helper.warmed carries `# lint: allow(jit-purity)` — reachable
+    and impure, but justified at its own site."""
+    result = lint(
+        FIXTURES / "tpu" / "xmod_root.py", rules={"jit-purity": RULES["jit-purity"]}
+    )
+    assert not any("perf_counter" in f.message for f in result.new)
+
+
+def test_jit_purity_cross_module_clean_root():
+    """A root that only reaches the pure sibling helper stays silent."""
+    result = lint(
+        FIXTURES / "tpu" / "xmod_clean_root.py",
+        rules={"jit-purity": RULES["jit-purity"]},
+    )
+    assert not result.new, [(f.path, f.line) for f in result.new]
+
+
+# ===========================================================================
+# Part 2: narwhal-topo (tools/analysis) — the whole-program gate
+# ===========================================================================
+
+from tools.analysis import (  # noqa: E402
+    DETECTORS,
+    Context,
+    extract,
+    run_detectors,
+)
+from tools.analysis.__main__ import (  # noqa: E402
+    ARTIFACT_JSON,
+    DEFAULT_BASELINE as TOPO_BASELINE,
+    topology_doc,
+)
+from tools.analysis.extractor import DEFAULT_ROOTS  # noqa: E402
+
+TOPO_FIXTURES = REPO / "tests" / "topo_fixtures"
+
+
+def _topo_ctx():
+    topo, extractor = extract(REPO)
+    return Context(topo, extractor.program, REPO)
+
+
+def _fixture_result(fixture: str, symbol: str, rule: str):
+    # package="" loads ONLY the fixture file: detectors that scan every
+    # program module (dropped-handle-escape) must not see sibling
+    # fixtures' deliberate violations.
+    topo, extractor = extract(
+        REPO,
+        package="",
+        roots=[f"tests/topo_fixtures/{fixture}::{symbol}"],
+    )
+    ctx = Context(topo, extractor.program, REPO)
+    return run_detectors(ctx, detectors={rule: DETECTORS[rule]})
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_topo_tree_has_no_new_findings():
+    """`python -m tools.analysis` must be clean modulo the (empty)
+    baseline. If this fails: fix the wiring, or justify with an inline
+    `# lint: allow(<detector>)` at the anchor site."""
+    ctx = _topo_ctx()
+    result = run_detectors(ctx, baseline=Baseline.load(TOPO_BASELINE))
+    details = "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new
+    )
+    assert not result.new, f"new topology findings:\n{details}"
+    # The extraction actually modeled the pipeline (not a silent no-op).
+    assert len(ctx.topology.live_channels()) >= 20
+    assert len(ctx.topology.tasks) >= 30
+    # The one justified suppression: the protocol-bounded core<->proposer
+    # wait cycle (primary/core.py).
+    assert any(f.rule == "bounded-channel-cycle" for f in result.suppressed)
+
+
+def test_topo_baseline_stays_empty():
+    """Like lint's: the topology baseline only ever shrinks, and it starts
+    (and must stay) EMPTY — new findings are fixed or justified inline."""
+    baseline = json.loads(TOPO_BASELINE.read_text(encoding="utf-8"))
+    assert baseline["findings"] == []
+
+
+def test_topo_detector_catalog_is_complete():
+    expected = {
+        "orphan-producer",
+        "orphan-consumer",
+        "bounded-channel-cycle",
+        "dropped-handle-escape",
+        "wire-schema",
+        "cross-module-jit-purity",
+    }
+    assert expected == set(DETECTORS), sorted(DETECTORS)
+    for det in DETECTORS.values():
+        assert det.summary, f"{det.name} has no summary"
+
+
+# -- the pinned topology artifact -------------------------------------------
+
+
+def test_topology_artifact_is_current():
+    """The checked-in topology.json must match a fresh extraction of the
+    live codebase. Wiring changed? Regenerate with
+    `python -m tools.analysis --write-artifact` and review the diff —
+    that review IS the point of pinning the pipeline shape."""
+    topo, _ = extract(REPO)
+    fresh = topology_doc(topo, DEFAULT_ROOTS)
+    checked_in = json.loads(ARTIFACT_JSON.read_text(encoding="utf-8"))
+    assert fresh == checked_in, (
+        "stale tools/analysis/topology.json — regenerate with "
+        "`python -m tools.analysis --write-artifact` and review the diff"
+    )
+
+
+def test_topology_artifact_matches_known_pipeline():
+    """Semantic pins on the real architecture: the load-bearing edges the
+    paper's pipeline (workers -> primary -> consensus -> executor) implies
+    must be present in the artifact."""
+    doc = json.loads(ARTIFACT_JSON.read_text(encoding="utf-8"))
+    edges = {(e["task"], e["channel"], e["op"]) for e in doc["edges"]}
+    # The PR-6 wedge pair: executor output produced, drained by __main__.
+    assert ("ExecutorCore.run", "node/execution_output", "send_many") in edges
+    assert (
+        "_run_node._drain_execution_output",
+        "node/execution_output",
+        "recv",
+    ) in edges
+    # Core feeds consensus; consensus feeds the executor and the primary.
+    assert ("Core.run", "node/new_certificates", "send") in edges
+    assert ("Consensus.run", "node/new_certificates", "recv") in edges
+    assert ("Consensus.run", "node/consensus_output", "send") in edges
+    assert ("Subscriber.run", "node/consensus_output", "recv") in edges
+    # The speculative tap is non-blocking by design.
+    assert ("Consensus.run", "node/accepted_certificates", "try_send") in edges
+    # Worker pipeline: ingest -> batch maker -> quorum -> processor.
+    assert ("BatchMaker.run", "worker/quorum_waiter", "send") in edges
+    assert ("QuorumWaiter.run", "worker/quorum_waiter", "recv") in edges
+    caps = {c["id"]: c["capacity"] for c in doc["channels"]}
+    assert caps["node/execution_output"] == 10_000
+    assert caps["primary/state_handler"] == 100
+
+
+def test_topology_dot_artifact_exists_and_renders_channels():
+    dot = (ARTIFACT_JSON.parent / "topology.dot").read_text(encoding="utf-8")
+    assert "digraph" in dot
+    assert "node/execution_output" in dot and "worker/batch_maker" in dot
+
+
+# -- per-detector fixtures (tripping + clean, pinned counts) ----------------
+
+
+def test_orphan_producer_flags_the_pr6_wedge_fixture():
+    result = _fixture_result(
+        "orphan_producer_trip.py", "MiniNode", "orphan-producer"
+    )
+    assert len(result.new) == 1, [(f.line, f.message) for f in result.new]
+    assert "node/execution_output" in result.new[0].message
+
+
+def test_orphan_producer_clean_fixture():
+    result = _fixture_result(
+        "orphan_producer_clean.py", "MiniNode", "orphan-producer"
+    )
+    assert not result.new, [(f.line, f.message) for f in result.new]
+
+
+def test_orphan_consumer_fixtures():
+    trip = _fixture_result("orphan_consumer_trip.py", "DeadNode", "orphan-consumer")
+    assert len(trip.new) == 1, [(f.line, f.message) for f in trip.new]
+    assert "tx_ghost" in trip.new[0].message
+    clean = _fixture_result(
+        "orphan_consumer_clean.py", "DeadNode", "orphan-consumer"
+    )
+    assert not clean.new, [(f.line, f.message) for f in clean.new]
+
+
+def test_bounded_cycle_fixtures():
+    trip = _fixture_result("cycle_trip.py", "CycleNode", "bounded-channel-cycle")
+    assert len(trip.new) == 1, [(f.line, f.message) for f in trip.new]
+    assert "Pinger.run" in trip.new[0].message
+    assert "Ponger.run" in trip.new[0].message
+    clean = _fixture_result("cycle_clean.py", "CycleNode", "bounded-channel-cycle")
+    assert not clean.new, [(f.line, f.message) for f in clean.new]
+
+
+def test_dropped_handle_fixtures():
+    """Three escapes pinned: the attr-held task, the dict-tuple park, and
+    the dropped spawn() result."""
+    trip = _fixture_result("dropped_handle_trip.py", "Leaky", "dropped-handle-escape")
+    assert len(trip.new) == 3, [(f.line, f.message) for f in trip.new]
+    msgs = " | ".join(f.message for f in trip.new)
+    assert "_task" in msgs and "pending" in msgs and "spawn" in msgs
+    clean = _fixture_result(
+        "dropped_handle_clean.py", "Tidy", "dropped-handle-escape"
+    )
+    assert not clean.new, [(f.line, f.message) for f in clean.new]
+
+
+def test_wire_schema_fixture_and_real_registry():
+    from tools.analysis.extractor import Program, Topology
+
+    # Tripping fixture: one duplicate tag + one missing golden entry.
+    program = Program(REPO, None)
+    ctx = Context(
+        Topology(),
+        program,
+        REPO,
+        messages_path="tests/topo_fixtures/wire_schema_trip.py",
+        golden_path="tests/topo_fixtures/wire_schema_golden.json",
+    )
+    result = run_detectors(ctx, detectors={"wire-schema": DETECTORS["wire-schema"]})
+    assert len(result.new) == 2, [(f.line, f.message) for f in result.new]
+    msgs = " | ".join(f.message for f in result.new)
+    assert "collides" in msgs and "golden entry" in msgs
+    # The real registry must be tag-unique and fully snapshotted.
+    real = run_detectors(
+        _topo_ctx(), detectors={"wire-schema": DETECTORS["wire-schema"]}
+    )
+    assert not real.new, [(f.line, f.message) for f in real.new]
+
+
+def test_cross_module_jit_purity_detector_on_fixture_package():
+    topo, extractor = extract(
+        REPO,
+        package="tests/lint_fixtures/tpu",
+        roots=["tests/lint_fixtures/tpu/xmod_root.py::kernel"],
+    )
+    ctx = Context(topo, extractor.program, REPO)
+    result = run_detectors(
+        ctx,
+        detectors={
+            "cross-module-jit-purity": DETECTORS["cross-module-jit-purity"]
+        },
+    )
+    assert len(result.new) == 2, [(f.path, f.line) for f in result.new]
+    assert all(f.path.endswith("xmod_helper.py") for f in result.new)
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_topo_cli_gate_and_artifacts(tmp_path):
+    """The satellite-task invocation: detectors + JSON/DOT artifacts in
+    one run, exit 0 on the clean tree with a current checked-in artifact."""
+    out_json, out_dot = tmp_path / "t.json", tmp_path / "t.dot"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.analysis",
+            "--check-artifact", "--format", "json",
+            "--json", str(out_json), "--dot", str(out_dot),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] and not payload["artifact_stale"]
+    doc = json.loads(out_json.read_text())
+    assert doc == json.loads(ARTIFACT_JSON.read_text(encoding="utf-8"))
+    assert "digraph" in out_dot.read_text()
+
+
+def test_topo_cli_exit_code_on_findings():
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.analysis",
+            "--package", "tests/topo_fixtures",
+            "--roots", "tests/topo_fixtures/cycle_trip.py::CycleNode",
+            "--rule", "bounded-channel-cycle",
+            "--no-baseline", "--format", "json",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert not payload["ok"]
+    assert {f["rule"] for f in payload["new"]} == {"bounded-channel-cycle"}
+
+
+def test_topo_cli_list_rules():
+    from tools.analysis.__main__ import main as topo_main
+
+    assert topo_main(["--list-rules"]) == 0
+
+
+# -- performance ------------------------------------------------------------
+
+
+def test_topo_full_run_is_fast():
+    """Extraction + every detector over the full tree must stay cheap
+    enough to gate every tier-1 run (<15s; ~1s in practice)."""
+    t0 = time.perf_counter()
+    ctx = _topo_ctx()
+    run_detectors(ctx, baseline=Baseline.load(TOPO_BASELINE))
+    assert time.perf_counter() - t0 < 15.0

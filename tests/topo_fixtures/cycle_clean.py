@@ -1,0 +1,52 @@
+"""Clean twin of cycle_trip: the back-edge is a non-blocking try_send
+(drop on full), so no task can block in send while holding the loop —
+the wait-for graph has no cycle of blocking edges."""
+
+import asyncio
+
+from narwhal_tpu.channels import Channel
+
+
+class Pinger:
+    def __init__(self, rx: Channel, tx: Channel):
+        self.rx = rx
+        self.tx = tx
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            item = await self.rx.recv()
+            await self.tx.send(item)
+
+
+class Ponger:
+    def __init__(self, rx: Channel, tx: Channel):
+        self.rx = rx
+        self.tx = tx
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            item = await self.rx.recv()
+            self.tx.try_send(item)  # drop-on-full: cannot block the loop
+
+
+class CycleNode:
+    def __init__(self):
+        self.tx_ping = Channel(16)
+        self.tx_pong = Channel(16)
+        self.pinger = Pinger(self.tx_ping, self.tx_pong)
+        self.ponger = Ponger(self.tx_pong, self.tx_ping)
+        self._tasks = []
+
+    async def spawn(self):
+        self._tasks.append(self.pinger.spawn())
+        self._tasks.append(self.ponger.spawn())
+
+    async def shutdown(self):
+        for t in self._tasks:
+            t.cancel()

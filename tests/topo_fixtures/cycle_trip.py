@@ -1,0 +1,54 @@
+"""Tripping fixture for bounded-channel-cycle: two tasks, each blocking-
+sending into the bounded channel the other consumes. If both channels
+fill, both tasks block in send and neither ever drains — the deadlock
+class PR-6's everything-is-bounded backpressure made load-reachable.
+Static fixture: analyzed by tools.analysis, never imported."""
+
+import asyncio
+
+from narwhal_tpu.channels import Channel
+
+
+class Pinger:
+    def __init__(self, rx: Channel, tx: Channel):
+        self.rx = rx
+        self.tx = tx
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            item = await self.rx.recv()
+            await self.tx.send(item)
+
+
+class Ponger:
+    def __init__(self, rx: Channel, tx: Channel):
+        self.rx = rx
+        self.tx = tx
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            item = await self.rx.recv()
+            await self.tx.send(item)
+
+
+class CycleNode:
+    def __init__(self):
+        self.tx_ping = Channel(16)
+        self.tx_pong = Channel(16)
+        self.pinger = Pinger(self.tx_ping, self.tx_pong)
+        self.ponger = Ponger(self.tx_pong, self.tx_ping)
+        self._tasks = []
+
+    async def spawn(self):
+        self._tasks.append(self.pinger.spawn())
+        self._tasks.append(self.ponger.spawn())
+
+    async def shutdown(self):
+        for t in self._tasks:
+            t.cancel()

@@ -1,0 +1,62 @@
+"""Clean twin of dropped_handle_trip: every escape pattern has an owner —
+the attr-held task is cancelled on shutdown, the parked dict tasks are
+cancelled by iterating values, a swapped-out local is cancelled, and the
+spawn-like method's handle is stored in a drained list."""
+
+import asyncio
+
+from narwhal_tpu.channels import drain_cancelled
+
+
+class Tidy:
+    def __init__(self):
+        self._task = None
+        self.pending = {}
+        self._fetches = set()
+
+    def spawn(self):
+        self._task = asyncio.ensure_future(self.run())
+        return self._task  # ownership also offered to the caller
+
+    def park(self, key):
+        self.pending[key] = (1, asyncio.ensure_future(self.wait()))
+
+    def track(self):
+        self._fetches.add(asyncio.ensure_future(self.wait()))
+
+    async def run(self):
+        while True:
+            await asyncio.sleep(1)
+
+    async def wait(self):
+        await asyncio.sleep(10)
+
+    async def shutdown(self):
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+        for _, t in self.pending.values():
+            t.cancel()
+        self.pending.clear()
+        await drain_cancelled(self._fetches, who="tidy")
+
+
+class Child:
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            await asyncio.sleep(1)
+
+
+class Keeper:
+    def __init__(self):
+        self._tasks = []
+
+    def boot(self):
+        self._tasks.append(Child().spawn())
+
+    async def shutdown(self):
+        for t in self._tasks:
+            t.cancel()

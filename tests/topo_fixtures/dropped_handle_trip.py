@@ -1,0 +1,40 @@
+"""Tripping fixture for dropped-handle-escape: three escapes —
+`Leaky._task` (attr-held, never cancelled, not returned), `Leaky.pending`
+(tasks tucked into dict tuples, never cancelled), and `Dropper.boot`
+dropping a spawn-like method's returned handle on the floor.
+Static fixture: analyzed by tools.analysis, never imported."""
+
+import asyncio
+
+
+class Leaky:
+    def __init__(self):
+        self._task = None
+        self.pending = {}
+
+    def spawn(self):
+        self._task = asyncio.ensure_future(self.run())
+
+    def park(self, key):
+        self.pending[key] = (1, asyncio.ensure_future(self.wait()))
+
+    async def run(self):
+        while True:
+            await asyncio.sleep(1)
+
+    async def wait(self):
+        await asyncio.sleep(10)
+
+
+class Child:
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            await asyncio.sleep(1)
+
+
+class Dropper:
+    def boot(self):
+        Child().spawn()

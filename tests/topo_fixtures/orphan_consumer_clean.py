@@ -1,0 +1,37 @@
+"""Clean twin of orphan_consumer_trip: the ghost channel gains a feeder
+task, so every consumer has a reachable producer."""
+
+import asyncio
+
+from narwhal_tpu.channels import Channel
+
+
+class Sink:
+    def __init__(self, rx: Channel):
+        self.rx = rx
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            await self.rx.recv()
+
+
+class DeadNode:
+    def __init__(self):
+        self.tx_ghost = Channel(64)
+        self.sink = Sink(self.tx_ghost)
+        self._tasks = []
+
+    async def spawn(self):
+        self._tasks.append(self.sink.spawn())
+        self._tasks.append(asyncio.ensure_future(self._feed()))
+
+    async def _feed(self):
+        while True:
+            await self.tx_ghost.send(b"item")
+
+    async def shutdown(self):
+        for t in self._tasks:
+            t.cancel()

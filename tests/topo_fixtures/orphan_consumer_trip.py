@@ -1,0 +1,33 @@
+"""Tripping fixture for orphan-consumer: an actor parked forever on a
+channel no task anywhere sends into — dead wiring that presents as a
+hang. Static fixture: analyzed by tools.analysis, never imported."""
+
+import asyncio
+
+from narwhal_tpu.channels import Channel
+
+
+class Sink:
+    def __init__(self, rx: Channel):
+        self.rx = rx
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            await self.rx.recv()
+
+
+class DeadNode:
+    def __init__(self):
+        self.tx_ghost = Channel(64)
+        self.sink = Sink(self.tx_ghost)
+        self._tasks = []
+
+    async def spawn(self):
+        self._tasks.append(self.sink.spawn())
+
+    async def shutdown(self):
+        for t in self._tasks:
+            t.cancel()

@@ -1,0 +1,52 @@
+"""Clean twin of orphan_producer_trip: identical wiring plus the drain
+task `__main__` grew after the PR-6 wedge — every producer now has a
+reachable consumer, so `orphan-producer` must stay silent."""
+
+import asyncio
+
+from narwhal_tpu.channels import Channel, metered_channel
+
+
+class MiniExecutor:
+    def __init__(self, rx_consensus: Channel, tx_output: Channel):
+        self.rx_consensus = rx_consensus
+        self.tx_output = tx_output
+
+    def spawn(self):
+        return asyncio.ensure_future(self.run())
+
+    async def run(self):
+        while True:
+            item = await self.rx_consensus.recv()
+            await self.tx_output.send_many([(b"", item)])
+
+
+class MiniNode:
+    def __init__(self, registry):
+        def chan(name, capacity):
+            return metered_channel(registry, "node", name, capacity)
+
+        self.tx_consensus_output = chan("consensus_output", 10_000)
+        self.tx_execution_output = chan("execution_output", 10_000)
+        self.executor = MiniExecutor(
+            self.tx_consensus_output, self.tx_execution_output
+        )
+        self._tasks = []
+
+    async def spawn(self):
+        self._tasks.append(self.executor.spawn())
+        self._tasks.append(asyncio.ensure_future(self._feed()))
+        self._tasks.append(asyncio.ensure_future(self._drain()))
+
+    async def _feed(self):
+        while True:
+            await self.tx_consensus_output.send(b"tx")
+
+    async def _drain(self):
+        # The standalone embedder's fix: consume and drop.
+        while True:
+            await self.tx_execution_output.recv()
+
+    async def shutdown(self):
+        for t in self._tasks:
+            t.cancel()

@@ -1,0 +1,35 @@
+"""Tripping fixture for wire-schema: `BadEcho` reuses tag 7 (collision
+with `Echo`), and `Orphan` (tag 9) has no entry in the golden snapshot
+(wire_schema_golden.json pins tags 7 and 8 only).
+Static fixture: analyzed by tools.analysis, never imported."""
+
+REGISTRY = {}
+
+
+def message(tag):
+    def deco(cls):
+        cls.TAG = tag
+        REGISTRY[tag] = cls
+        return cls
+
+    return deco
+
+
+@message(7)
+class Echo:
+    pass
+
+
+@message(8)
+class Ack:
+    pass
+
+
+@message(7)
+class BadEcho:  # duplicate tag: finding 1
+    pass
+
+
+@message(9)
+class Orphan:  # no golden entry: finding 2
+    pass

@@ -1,0 +1,18 @@
+"""narwhal-topo: whole-program actor/channel topology analyzer.
+
+Usage: `python -m tools.analysis` — see tools/analysis/__main__.py for
+flags and README.md § "Static analysis" for the detector catalog, the
+checked-in topology artifact, and the regeneration workflow. Shares
+narwhal-lint's Finding/suppression/baseline machinery (tools/lint).
+"""
+
+from .detectors import DETECTORS, Context, run_detectors  # noqa: F401
+from .extractor import (  # noqa: F401
+    DEFAULT_PACKAGE,
+    DEFAULT_ROOTS,
+    Extractor,
+    Program,
+    RootSpec,
+    Topology,
+    extract,
+)

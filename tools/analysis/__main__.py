@@ -1,0 +1,244 @@
+"""CLI: `python -m tools.analysis [flags]`.
+
+Exit status mirrors narwhal-lint: 0 when clean (every finding suppressed
+or baselined, artifact current when checked), 1 when new findings exist
+or the checked-in topology artifact is stale, 2 on usage errors.
+
+Typical invocations:
+
+    python -m tools.analysis                        # detectors, the gate
+    python -m tools.analysis --check-artifact       # + stale-artifact check
+    python -m tools.analysis --write-artifact       # regenerate topology.json/.dot
+    python -m tools.analysis --dot out.dot --json out.json
+    python -m tools.analysis --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from tools.lint.engine import Baseline
+from tools.lint.report import render_json, render_text
+
+from .detectors import DETECTORS, Context, run_detectors
+from .extractor import DEFAULT_PACKAGE, DEFAULT_ROOTS, extract
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.json")
+ARTIFACT_JSON = Path(__file__).with_name("topology.json")
+ARTIFACT_DOT = Path(__file__).with_name("topology.dot")
+
+
+# ---------------------------------------------------------------------------
+# Artifact serialization (canonical: sorted, line-number free so edits
+# above a wiring site don't churn the checked-in file — the lint
+# baseline's snippet-identity philosophy)
+# ---------------------------------------------------------------------------
+
+
+def topology_doc(topo, roots) -> dict:
+    edges = sorted(
+        {
+            (op.task, op.channel, op.kind)
+            for op in topo.ops
+        }
+    )
+    live = topo.live_channels()
+    return {
+        "version": 1,
+        "roots": sorted(roots),
+        "channels": [
+            {
+                "id": cid,
+                "capacity": ch.capacity,
+                "path": ch.path,
+            }
+            for cid, ch in sorted(live.items())
+        ],
+        "tasks": sorted({op.task for op in topo.ops}),
+        "edges": [
+            {"task": t, "channel": c, "op": k} for t, c, k in edges
+        ],
+    }
+
+
+def render_dot(doc: dict) -> str:
+    out = ["digraph narwhal_topology {", "  rankdir=LR;",
+           '  node [fontname="monospace", fontsize=10];']
+    for ch in doc["channels"]:
+        out.append(
+            f'  "chan:{ch["id"]}" [shape=box, style=rounded, '
+            f'label="{ch["id"]}\\ncap={ch["capacity"]}"];'
+        )
+    for t in doc["tasks"]:
+        out.append(f'  "task:{t}" [shape=ellipse, label="{t}"];')
+    for e in doc["edges"]:
+        style = ', style=dashed' if e["op"].startswith("try_") else ""
+        if e["op"] in ("send", "send_many", "try_send"):
+            out.append(
+                f'  "task:{e["task"]}" -> "chan:{e["channel"]}"'
+                f' [label="{e["op"]}"{style}];'
+            )
+        else:
+            out.append(
+                f'  "chan:{e["channel"]}" -> "task:{e["task"]}"'
+                f' [label="{e["op"]}"{style}];'
+            )
+    out.append("}")
+    return "\n".join(out) + "\n"
+
+
+def render_mermaid(doc: dict) -> str:
+    """A README-embeddable pipeline diagram (flowchart LR)."""
+
+    def nid(name: str) -> str:
+        return (
+            name.replace("/", "_").replace(".", "_").replace(":", "_")
+            .replace("#", "_")
+        )
+
+    out = ["flowchart LR"]
+    for ch in doc["channels"]:
+        out.append(f'    C_{nid(ch["id"])}[("{ch["id"]} (cap {ch["capacity"]})")]')
+    seen = set()
+    for e in doc["edges"]:
+        t, c = f'T_{nid(e["task"])}', f'C_{nid(e["channel"])}'
+        if e["task"] not in seen:
+            seen.add(e["task"])
+            out.append(f'    T_{nid(e["task"])}["{e["task"]}"]')
+        arrow = "-.->" if e["op"].startswith("try_") else "-->"
+        if e["op"] in ("send", "send_many", "try_send"):
+            out.append(f"    {t} {arrow} {c}")
+        else:
+            out.append(f"    {c} {arrow} {t}")
+    # dedupe while preserving order
+    deduped = list(dict.fromkeys(out))
+    return "\n".join(deduped) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description=(
+            "narwhal-topo: whole-program actor/channel topology analyzer "
+            "(orphan producers/consumers, bounded-channel deadlock cycles, "
+            "dropped task handles, wire schema, cross-module jit purity)"
+        ),
+    )
+    ap.add_argument("--root", type=Path, default=REPO_ROOT, help="repo root")
+    ap.add_argument("--package", default=DEFAULT_PACKAGE)
+    ap.add_argument(
+        "--roots",
+        action="append",
+        default=None,
+        metavar="FILE.py::Symbol",
+        help=f"wiring roots (default: {', '.join(DEFAULT_ROOTS)})",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text", dest="fmt")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument(
+        "--rule", action="append", default=None, metavar="NAME",
+        help="run only this detector (repeatable)",
+    )
+    ap.add_argument("--json", type=Path, default=None, help="write topology JSON")
+    ap.add_argument("--dot", type=Path, default=None, help="write topology DOT")
+    ap.add_argument("--mermaid", type=Path, default=None,
+                    help="write a mermaid pipeline diagram ('-' for stdout)")
+    ap.add_argument(
+        "--write-artifact", action="store_true",
+        help=f"regenerate the checked-in {ARTIFACT_JSON.name} + {ARTIFACT_DOT.name}",
+    )
+    ap.add_argument(
+        "--check-artifact", action="store_true",
+        help="fail (exit 1) when the checked-in topology.json is stale",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, det in sorted(DETECTORS.items()):
+            print(f"{name}\n    {det.summary}")
+        return 0
+
+    detectors = DETECTORS
+    if args.rule:
+        unknown = set(args.rule) - set(DETECTORS)
+        if unknown:
+            ap.error(f"unknown detector(s): {', '.join(sorted(unknown))}")
+        detectors = {n: DETECTORS[n] for n in args.rule}
+
+    roots = tuple(args.roots) if args.roots else DEFAULT_ROOTS
+    t0 = time.perf_counter()
+    topo, extractor = extract(args.root, package=args.package, roots=roots)
+    ctx = Context(topo, extractor.program, Path(args.root))
+    baseline = Baseline() if args.no_baseline else Baseline.load(args.baseline)
+    result = run_detectors(ctx, detectors=detectors, baseline=baseline)
+    elapsed = time.perf_counter() - t0
+
+    doc = topology_doc(topo, roots)
+    if args.json:
+        args.json.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+    if args.dot:
+        args.dot.write_text(render_dot(doc), encoding="utf-8")
+    if args.mermaid:
+        text = render_mermaid(doc)
+        if str(args.mermaid) == "-":
+            print(text, end="")
+        else:
+            args.mermaid.write_text(text, encoding="utf-8")
+    if args.write_artifact:
+        ARTIFACT_JSON.write_text(json.dumps(doc, indent=2) + "\n", encoding="utf-8")
+        ARTIFACT_DOT.write_text(render_dot(doc), encoding="utf-8")
+        print(
+            f"artifact: {len(doc['channels'])} channels / {len(doc['edges'])} "
+            f"edges written to {ARTIFACT_JSON} and {ARTIFACT_DOT}"
+        )
+        return 0
+
+    if args.write_baseline:
+        Baseline.dump(result.new + result.baselined, args.baseline)
+        print(
+            f"baseline: {len(result.new) + len(result.baselined)} finding(s) "
+            f"written to {args.baseline}"
+        )
+        return 0
+
+    stale_artifact = False
+    if args.check_artifact:
+        try:
+            current = json.loads(ARTIFACT_JSON.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            current = None
+        stale_artifact = current != doc
+
+    if args.fmt == "json":
+        payload = json.loads(render_json(result))
+        payload["channels"] = len(doc["channels"])
+        payload["tasks"] = len(doc["tasks"])
+        payload["artifact_stale"] = stale_artifact
+        payload["ok"] = result.ok and not stale_artifact
+        print(json.dumps(payload, indent=2))
+    else:
+        print(render_text(result, verbose=args.verbose))
+        print(
+            f"topology: {len(doc['channels'])} channels, "
+            f"{len(doc['tasks'])} tasks ({elapsed:.2f}s)"
+        )
+        if stale_artifact:
+            print(
+                f"STALE ARTIFACT: {ARTIFACT_JSON} no longer matches the "
+                "wiring — regenerate with `python -m tools.analysis "
+                "--write-artifact`"
+            )
+    return 0 if (result.ok and not stale_artifact) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

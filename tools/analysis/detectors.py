@@ -1,0 +1,722 @@
+"""narwhal-topo detectors: graph-level checks over the extracted topology.
+
+Each detector is grounded in a failure this repo actually paid for:
+
+| detector               | incident it guards against                         |
+|------------------------|----------------------------------------------------|
+| orphan-producer        | the PR-6 wedge: the standalone primary filled
+|                        | `tx_execution_output` (no consumer anywhere) and
+|                        | the executor's flush blocked forever at ~10k txs   |
+| orphan-consumer        | an actor parked on a channel nothing ever feeds —
+|                        | dead wiring that reads as a hang under test        |
+| bounded-channel-cycle  | PR 6 made every channel bounded for backpressure;
+|                        | a cycle of blocking sends across tasks is now a
+|                        | real deadlock under load, not a latent one         |
+| dropped-handle-escape  | task handles that cross a function boundary but are
+|                        | never cancelled/drained on any shutdown path (the
+|                        | PR-1/PR-2 shutdown-wedge class, whole-class view)  |
+| wire-schema            | message tags 25/26/35 were hand-assigned in PRs
+|                        | 4/6: duplicate tags or a registered class missing
+|                        | its golden snapshot entry must fail statically     |
+| cross-module-jit-purity| jit-purity (lint) used to stop at module borders;
+|                        | an impure helper imported into a jitted kernel
+|                        | still bakes trace-time state into the compile      |
+
+Findings reuse narwhal-lint's machinery end to end: the same `Finding`
+shape, the same `# lint: allow(<detector>)` inline suppressions (on the
+anchor line or the comment line above it), and the same empty-baseline
+discipline (tools/analysis/baseline.json only ever shrinks).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from tools.lint.engine import Baseline, Finding, _scan_allows
+
+from .extractor import Program, Topology
+
+DETECTORS: dict[str, "Detector"] = {}
+
+
+def register(cls):
+    det = cls()
+    assert det.name not in DETECTORS, f"duplicate detector {det.name}"
+    DETECTORS[det.name] = det
+    return cls
+
+
+@dataclass
+class Context:
+    """Everything a detector may need: the graph, the parsed program, and
+    repo-anchored paths for the schema checks."""
+
+    topology: Topology
+    program: Program
+    root: Path
+    messages_path: str = "narwhal_tpu/messages.py"
+    golden_path: str = "tests/snapshots/messages.json"
+    _allows: dict = field(default_factory=dict)
+    _lines: dict = field(default_factory=dict)
+
+    def lines(self, rel: str) -> list[str]:
+        if rel not in self._lines:
+            for info in self.program.modules.values():
+                if info.rel == rel:
+                    self._lines[rel] = info.lines
+                    break
+            else:
+                try:
+                    self._lines[rel] = (
+                        (self.root / rel).read_text(encoding="utf-8").splitlines()
+                    )
+                except OSError:
+                    self._lines[rel] = []
+        return self._lines[rel]
+
+    def snippet(self, rel: str, line: int) -> str:
+        lines = self.lines(rel)
+        return lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+
+    def allowed(self, finding: Finding) -> bool:
+        if finding.path not in self._allows:
+            self._allows[finding.path] = _scan_allows(self.lines(finding.path))
+        rules = self._allows[finding.path].get(finding.line, ())
+        return finding.rule in rules or "*" in rules
+
+
+class Detector:
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: Context, rel: str, line: int, message: str) -> Finding:
+        return Finding(self.name, rel, line, 0, message, ctx.snippet(rel, line))
+
+
+def _sites(ops, limit: int = 4) -> str:
+    locs = sorted({f"{o.task} @ {o.path}:{o.line}" for o in ops})
+    extra = f" (+{len(locs) - limit} more)" if len(locs) > limit else ""
+    return "; ".join(locs[:limit]) + extra
+
+
+# ---------------------------------------------------------------------------
+# orphan-producer / orphan-consumer
+# ---------------------------------------------------------------------------
+
+
+@register
+class OrphanProducer(Detector):
+    name = "orphan-producer"
+    summary = (
+        "a channel some task sends into but NO task anywhere receives from: "
+        "bounded channels fill, and the first blocking send after that wedges "
+        "its sender forever (the PR-6 tx_execution_output wedge at ~10k txs)"
+    )
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        topo = ctx.topology
+        for cid, ch in sorted(topo.live_channels().items()):
+            sends = topo.senders(cid)
+            if sends and not topo.receivers(cid):
+                # Anchor at the first producing send, NOT the creation
+                # site: metered channels share one factory line, and an
+                # allow there would suppress every channel's findings.
+                anchor = min(sends, key=lambda o: (o.path, o.line))
+                yield self.finding(
+                    ctx,
+                    anchor.path,
+                    anchor.line,
+                    f"channel `{cid}` (capacity {ch.capacity}, created at "
+                    f"{ch.path}:{ch.line}) has producers but no reachable "
+                    f"consumer — it fills, then the first blocking send "
+                    f"wedges its task forever. Producers: {_sites(sends)}. "
+                    f"Wire a consumer (or drain-and-drop like __main__'s "
+                    f"execution-output drain)",
+                )
+
+
+@register
+class OrphanConsumer(Detector):
+    name = "orphan-consumer"
+    summary = (
+        "a channel some task receives from but NO task anywhere sends into: "
+        "the consumer is parked forever — dead wiring that presents as a "
+        "hang (an actor that never advances, a shutdown that never drains)"
+    )
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        topo = ctx.topology
+        for cid, ch in sorted(topo.live_channels().items()):
+            recvs = topo.receivers(cid)
+            if recvs and not topo.senders(cid):
+                anchor = min(recvs, key=lambda o: (o.path, o.line))
+                yield self.finding(
+                    ctx,
+                    anchor.path,
+                    anchor.line,
+                    f"channel `{cid}` (created at {ch.path}:{ch.line}) has "
+                    f"consumers but no reachable producer — {_sites(recvs)} "
+                    f"wait(s) forever. Either the producing path was never "
+                    f"wired or the channel is dead",
+                )
+
+
+# ---------------------------------------------------------------------------
+# bounded-channel-cycle
+# ---------------------------------------------------------------------------
+
+
+@register
+class BoundedChannelCycle(Detector):
+    name = "bounded-channel-cycle"
+    summary = (
+        "a cycle of BLOCKING sends through bounded channels across tasks: "
+        "if every channel on the loop fills, every task on the loop blocks "
+        "in send and nothing can ever drain — a backpressure deadlock "
+        "(every channel is bounded since PR 6, so this is load-reachable)"
+    )
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        topo = ctx.topology
+        graph = topo.wait_graph()
+        for scc in _sccs(graph):
+            chans = sorted(n[5:] for n in scc if n.startswith("chan:"))
+            if not chans:
+                continue
+            cycle = _cycle_path(graph, scc)
+            # Anchor at the first blocking-send SITE on the cycle (a
+            # creation-site anchor would land on the shared metered
+            # factory line and over-suppress).
+            cycle_tasks = {n[5:] for n in scc if n.startswith("task:")}
+            cycle_chans = set(chans)
+            send_ops = [
+                o
+                for o in topo.ops
+                if o.is_send
+                and o.blocking
+                and o.task in cycle_tasks
+                and o.channel in cycle_chans
+            ]
+            anchor = min(send_ops, key=lambda o: (o.path, o.line))
+            yield self.finding(
+                ctx,
+                anchor.path,
+                anchor.line,
+                "bounded-channel deadlock cycle: "
+                + " -> ".join(_pretty(n) for n in cycle)
+                + " -> "
+                + _pretty(cycle[0])
+                + ". If these channels fill together, every task on the "
+                "loop blocks in send. Break it (try_send one edge, drain "
+                "before send) or justify the capacity argument inline",
+            )
+
+
+def _sccs(graph: dict[str, set[str]]) -> list[frozenset]:
+    """Tarjan (iterative), deterministic order; only cyclic SCCs (size > 1
+    or an explicit self-loop) are returned, sorted."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[frozenset] = []
+    counter = [0]
+    nodes = sorted(set(graph) | {m for vs in graph.values() for m in vs})
+
+    for start in nodes:
+        if start in index:
+            continue
+        work = [(start, iter(sorted(graph.get(start, ()))))]
+        index[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on_stack.add(start)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1 or node in graph.get(node, ()):
+                    out.append(frozenset(comp))
+    return sorted(out, key=lambda c: sorted(c))
+
+
+def _cycle_path(graph: dict[str, set[str]], scc: frozenset) -> list[str]:
+    """A deterministic representative cycle inside the SCC, starting at
+    the lexicographically first channel node."""
+    start = sorted(n for n in scc if n.startswith("chan:"))[0]
+    path = [start]
+    seen = {start}
+    node = start
+    while True:
+        nxts = sorted(n for n in graph.get(node, ()) if n in scc)
+        if not nxts:
+            return path
+        node = nxts[0]
+        if node == start or node in seen:
+            return path
+        seen.add(node)
+        path.append(node)
+
+
+def _pretty(node: str) -> str:
+    if node.startswith("chan:"):
+        return f"[{node[5:]}]"
+    return node[5:]
+
+
+# ---------------------------------------------------------------------------
+# dropped-handle-escape
+# ---------------------------------------------------------------------------
+
+_SPAWN_NAMES = {"ensure_future", "create_task"}
+_DRAIN_FUNCS = {"drain_cancelled", "gather", "wait"}
+
+
+@register
+class DroppedHandleEscape(Detector):
+    name = "dropped-handle-escape"
+    summary = (
+        "a task handle that crosses a function boundary (stored in an "
+        "attribute, or returned by a spawn-like method whose caller drops "
+        "it) with no shutdown path that cancels or drains it: at teardown "
+        "the task lives on — the shutdown-wedge class, seen whole-program"
+    )
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        spawn_methods = self._task_returning_methods(ctx.program)
+        for dotted in sorted(ctx.program.modules):
+            info = ctx.program.modules[dotted]
+            for cname in sorted(info.classes):
+                yield from self._check_class(
+                    ctx, info, info.classes[cname], spawn_methods
+                )
+
+    # -- which method NAMES hand a fresh task to their caller -----------
+    # (Name-keyed, so `send` is deliberately excluded below: Watch.send /
+    # FrameSender.send / NetworkClient.send collide on the name and only
+    # the last returns a handle — that idiom has its own owner discipline
+    # via cancel_handlers.)
+    _NAME_DENYLIST = frozenset(
+        {"send", "send_many", "try_send", "unreliable_send", "request", "write"}
+    )
+
+    def _task_returning_methods(self, program: Program) -> set:
+        out: set[str] = set()
+        for info in program.modules.values():
+            for cls in info.classes.values():
+                for mname, mnode in cls.methods.items():
+                    if mname in self._NAME_DENYLIST:
+                        continue
+                    for node in ast.walk(mnode):
+                        if (
+                            isinstance(node, ast.Return)
+                            and node.value is not None
+                            and _mentions_spawn(node.value, mnode)
+                        ):
+                            out.add(mname)
+        return out
+
+    def _check_class(self, ctx, info, cls, spawn_methods) -> Iterator[Finding]:
+        # 1. attrs that ever hold a task handle (directly or inside a
+        #    literal/tuple/subscript), with the storing site remembered.
+        held: dict[str, tuple[int, bool]] = {}  # attr -> (line, returned)
+        for mname, mnode in sorted(cls.methods.items()):
+            returned_names = self._returned_names(mnode)
+            task_locals = self._task_locals(mnode, spawn_methods)
+            for node in ast.walk(mnode):
+                attr, line, value = self._stored_attr(node)
+                if attr is None:
+                    continue
+                if not _is_task_expr(value, spawn_methods, task_locals):
+                    continue
+                returned = attr in returned_names or any(
+                    n in returned_names for n in _names_in(value)
+                )
+                prev = held.get(attr)
+                held[attr] = (
+                    min(prev[0], line) if prev else line,
+                    (prev[1] if prev else False) or returned,
+                )
+        if not held:
+            drained: set[str] = set()
+        else:
+            drained = self._drained_attrs(cls)
+        for attr in sorted(held):
+            line, returned = held[attr]
+            if returned or attr in drained:
+                continue
+            yield self.finding(
+                ctx,
+                info.rel,
+                line,
+                f"`self.{attr}` of `{cls.name}` holds task handle(s) but no "
+                "method of the class cancels or drains it — at shutdown the "
+                "task(s) survive the owner (cancel in a shutdown/close path, "
+                "use drain_cancelled, or hand ownership to the caller by "
+                "returning the handle)",
+            )
+        # 2. spawn-like call results dropped on the floor. An *awaited*
+        #    `.spawn()` is the async-lifecycle idiom (returns addresses or
+        #    None); only a bare un-awaited call drops a handle.
+        for mname, mnode in sorted(cls.methods.items()):
+            for node in ast.walk(mnode):
+                if (
+                    isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in spawn_methods
+                ):
+                    yield self.finding(
+                        ctx,
+                        info.rel,
+                        node.lineno,
+                        f"`.{node.value.func.attr}(...)` returns a task "
+                        "handle that is dropped here — the spawned task can "
+                        "never be cancelled or drained; store it in a "
+                        "drained owner",
+                    )
+
+    def _returned_names(self, mnode) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(mnode):
+            if isinstance(node, ast.Return) and node.value is not None:
+                out.update(_names_in(node.value))
+        return out
+
+    def _task_locals(self, mnode, spawn_methods) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(mnode):
+            if isinstance(node, ast.Assign) and _is_task_expr(
+                node.value, spawn_methods, out
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _stored_attr(self, node):
+        """(attr, line, value-expr) when `node` stores into a self attr:
+        plain/containered assignment, subscript, or append/add/extend."""
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and _is_self(t.value):
+                    return t.attr, node.lineno, node.value
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and _is_self(t.value.value)
+                ):
+                    return t.value.attr, node.lineno, node.value
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("append", "add", "extend")
+            and isinstance(node.func.value, ast.Attribute)
+            and _is_self(node.func.value.value)
+            and node.args
+        ):
+            return node.func.value.attr, node.lineno, node.args[0]
+        return None, 0, None
+
+    def _drained_attrs(self, cls) -> set[str]:
+        """Attrs mentioned in a statement unit that also cancels/drains.
+        Units are simple statements and for-loops (`for t in self._tasks:
+        t.cancel()` counts `_tasks`); whole try/def bodies do not bleed.
+        A cancel through a local taken off the attr first (`t, self._x =
+        self._x, None` then `t.cancel()`) credits the attr too."""
+        out: set[str] = set()
+        for mnode in cls.methods.values():
+            # local name -> self attrs its binding expression mentions
+            local_attrs: dict[str, set[str]] = {}
+            units = []
+            for node in ast.walk(mnode):
+                if isinstance(
+                    node,
+                    (ast.Expr, ast.Assign, ast.AugAssign, ast.Return,
+                     ast.For, ast.AsyncFor, ast.With, ast.AsyncWith),
+                ):
+                    units.append(node)
+                if isinstance(node, ast.Assign):
+                    attrs = {
+                        sub.attr
+                        for sub in ast.walk(node.value)
+                        if isinstance(sub, ast.Attribute) and _is_self(sub.value)
+                    }
+                    if attrs:
+                        for t in node.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    local_attrs.setdefault(n.id, set()).update(attrs)
+            for unit in units:
+                cancels = False
+                for sub in ast.walk(unit):
+                    if isinstance(sub, ast.Call):
+                        f = sub.func
+                        if isinstance(f, ast.Attribute) and f.attr in (
+                            "cancel", "cancel_all",
+                        ):
+                            cancels = True
+                        elif isinstance(f, ast.Name) and f.id in _DRAIN_FUNCS:
+                            cancels = True
+                        elif (
+                            isinstance(f, ast.Attribute)
+                            and f.attr in _DRAIN_FUNCS
+                            # `asyncio.wait(...)`/`asyncio.gather(...)`
+                            # drain; an unrelated method happening to be
+                            # NAMED wait/gather does not.
+                            and (
+                                f.attr == "drain_cancelled"
+                                or (
+                                    isinstance(f.value, ast.Name)
+                                    and f.value.id == "asyncio"
+                                )
+                            )
+                        ):
+                            cancels = True
+                if not cancels:
+                    continue
+                for sub in ast.walk(unit):
+                    if isinstance(sub, ast.Attribute) and _is_self(sub.value):
+                        out.add(sub.attr)
+                    elif isinstance(sub, ast.Name) and sub.id in local_attrs:
+                        out.update(local_attrs[sub.id])
+        return out
+
+
+def _is_self(node) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _names_in(node) -> set[str]:
+    if node is None:
+        return set()
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_task_expr(node, spawn_methods, task_locals) -> bool:
+    """STRUCTURAL task-expression check: the expression *is* a fresh task
+    handle — a direct ensure_future/create_task call, an un-awaited call
+    of a task-returning method, a local already known to hold one, or a
+    container literal carrying one. Deliberately not `ast.walk`-based:
+    `cert_task.result()` contains a task name but is not a task."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _SPAWN_NAMES:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in (
+            _SPAWN_NAMES | spawn_methods
+        ):
+            return True
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in task_locals
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(
+            _is_task_expr(e, spawn_methods, task_locals) for e in node.elts
+        )
+    if isinstance(node, ast.Dict):
+        return any(
+            _is_task_expr(v, spawn_methods, task_locals) for v in node.values
+        )
+    if isinstance(node, (ast.ListComp, ast.SetComp)):
+        return _is_task_expr(node.elt, spawn_methods, task_locals)
+    return False
+
+
+def _mentions_spawn(node, scope) -> bool:
+    """Does this return expression carry a freshly spawned task (directly
+    or via a local assigned from one)?"""
+    spawn_locals: set[str] = set()
+    for sub in ast.walk(scope):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            f = sub.value.func
+            name = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", "")
+            if name in _SPAWN_NAMES:
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        spawn_locals.add(t.id)
+                    elif isinstance(t, ast.Attribute) and _is_self(t.value):
+                        spawn_locals.add(f"self.{t.attr}")
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            name = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", "")
+            if name in _SPAWN_NAMES:
+                return True
+        elif isinstance(sub, ast.Name) and sub.id in spawn_locals:
+            return True
+        elif (
+            isinstance(sub, ast.Attribute)
+            and _is_self(sub.value)
+            and f"self.{sub.attr}" in spawn_locals
+        ):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# wire-schema
+# ---------------------------------------------------------------------------
+
+
+@register
+class WireSchema(Detector):
+    name = "wire-schema"
+    summary = (
+        "static wire-schema check: every `@message(tag)` class must have a "
+        "unique tag AND a golden entry in tests/snapshots/messages.json — "
+        "tags 25/26/35 were hand-assigned across PRs 4/6 and a collision "
+        "or an unsnapshotted format would only surface at decode time"
+    )
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        rel = ctx.messages_path
+        path = ctx.root / rel
+        if not path.exists():
+            return
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:
+            return
+        golden_path = ctx.root / ctx.golden_path
+        golden: set[str] = set()
+        golden_ok = golden_path.exists()
+        if golden_ok:
+            try:
+                golden = set(json.loads(golden_path.read_text(encoding="utf-8")))
+            except (OSError, ValueError):
+                golden_ok = False
+        seen: dict[int, tuple[str, int]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for deco in node.decorator_list:
+                if not (
+                    isinstance(deco, ast.Call)
+                    and isinstance(deco.func, ast.Name)
+                    and deco.func.id == "message"
+                    and deco.args
+                    and isinstance(deco.args[0], ast.Constant)
+                    and isinstance(deco.args[0].value, int)
+                ):
+                    continue
+                tag = deco.args[0].value
+                if tag in seen:
+                    other, oline = seen[tag]
+                    yield self.finding(
+                        ctx,
+                        rel,
+                        node.lineno,
+                        f"message tag {tag} on `{node.name}` collides with "
+                        f"`{other}` (line {oline}) — the decode registry "
+                        "would reject the second registration at import, "
+                        "and a silent renumber is a wire break",
+                    )
+                else:
+                    seen[tag] = (node.name, node.lineno)
+                    key = f"{tag}:{node.name}"
+                    if golden_ok and key not in golden:
+                        yield self.finding(
+                            ctx,
+                            rel,
+                            node.lineno,
+                            f"registered message `{node.name}` (tag {tag}) "
+                            f"has no golden entry `{key}` in "
+                            f"{ctx.golden_path} — regenerate the snapshot "
+                            "ADD-ONLY so the wire format is pinned",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# cross-module-jit-purity (delegates to the shared purity analysis)
+# ---------------------------------------------------------------------------
+
+
+@register
+class CrossModuleJitPurity(Detector):
+    name = "cross-module-jit-purity"
+    summary = (
+        "whole-package jit purity: functions reachable from a @jax.jit "
+        "root in tpu/ must stay pure ACROSS module boundaries — an impure "
+        "helper imported into a kernel runs once at trace time and is "
+        "baked into / elided from every later dispatch"
+    )
+
+    def check(self, ctx: Context) -> Iterator[Finding]:
+        from .purity import package_purity
+
+        tpu_files = sorted(
+            (ctx.root / info.rel)
+            for info in ctx.program.modules.values()
+            if "tpu" in Path(info.rel).parts[:-1]
+        )
+        if not tpu_files:
+            return
+        for imp in package_purity(tpu_files, ctx.root):
+            if not imp.cross_module:
+                continue  # same-module findings are narwhal-lint's beat
+            if imp.allowed_rules & {"jit-purity", "*"}:
+                continue  # one allow at the site covers both gates
+            yield self.finding(ctx, imp.path, imp.line, imp.message)
+
+
+# ---------------------------------------------------------------------------
+# Runner (shares the lint engine's Result so its reporters work verbatim)
+# ---------------------------------------------------------------------------
+
+from tools.lint.engine import Result  # noqa: E402
+
+
+def run_detectors(
+    ctx: Context,
+    detectors: dict | None = None,
+    baseline: Baseline | None = None,
+) -> Result:
+    detectors = DETECTORS if detectors is None else detectors
+    baseline = baseline or Baseline()
+    new, baselined, suppressed = [], [], []
+    for name in sorted(detectors):
+        for finding in detectors[name].check(ctx):
+            if ctx.allowed(finding):
+                suppressed.append(finding)
+            elif baseline.claim(finding):
+                baselined.append(finding)
+            else:
+                new.append(finding)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Result(
+        new, baselined, suppressed, baseline.stale(), len(ctx.program.modules)
+    )

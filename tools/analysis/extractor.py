@@ -1,0 +1,1107 @@
+"""narwhal-topo extractor: the whole-program actor/channel topology.
+
+narwhal-lint (tools/lint) gates *per-function* invariants; the bugs that
+actually wedged this system were *whole-program* properties — a channel
+filled by the executor that no task anywhere drains (the PR-6
+`tx_execution_output` wedge), or a cycle of bounded channels between two
+actors that can deadlock under load now that every edge is bounded. Those
+properties live in the wiring: `node.py`/`cluster.py`/`__main__.py`
+construct the actors, thread `Channel` objects through constructor
+parameters and attributes, and spawn the run loops. This module recovers
+that wiring statically.
+
+It is an *abstract interpreter* over stdlib-`ast`, specialized to the
+repo's actor idioms (the same trade narwhal-lint makes: precise for the
+patterns this codebase uses, honest `Unknown` for everything else):
+
+- **Values**: `ChannelVal` (a `Channel`/`metered_channel` creation site),
+  `ObjectVal` (an instantiated class with an attribute map), `WatchVal`,
+  `BoundMethodVal`, `CoroutineVal` (an un-awaited async-method call),
+  collection values, and `UNKNOWN`.
+- **Wiring**: `__init__` bodies are evaluated with arguments bound, so a
+  channel created in `PrimaryNode` and passed down three constructors
+  resolves to the same `ChannelVal` when `Core.run` finally receives on
+  it. Local factory functions whose return expression constructs a
+  channel (the ubiquitous `def chan(name, capacity)`) are followed.
+  Both branches of `if`/`try` are executed (over-approximation), and
+  conditional expressions prefer the channel-valued arm — the two arms
+  of `metered_channel(...) if registry else Channel(...)` are alternative
+  constructions of ONE logical channel.
+- **Tasks**: `asyncio.ensure_future`/`create_task` of a bound-method or
+  local-function coroutine starts a new *task context*; RPC handler
+  registrations (`server.route(Msg, self._on_x)`) and bound methods
+  passed as callbacks are task roots too. A coroutine handed to an
+  unknown sink (`pool.push(self._stage(...))`) is swept as its own task
+  at the end. Every send/recv op is recorded against the task that would
+  block on it — a passive helper's sends (`Synchronizer.missing_payload`)
+  belong to the calling task (`Core.run`), which is exactly what the
+  deadlock-cycle detector needs.
+- **Ops**: `.send`/`.send_many` (blocking) and `.try_send` (not) on a
+  resolved `ChannelVal` are producer edges; `.recv` (blocking) and
+  `.try_recv` are consumer edges.
+
+The result (`Topology`) is a bipartite task/channel graph that detectors
+query and the CLI serializes as the checked-in `topology.json` + DOT.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+# ---------------------------------------------------------------------------
+# Abstract values
+# ---------------------------------------------------------------------------
+
+
+class Unknown:
+    """The single honest fallback."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "Unknown"
+
+
+UNKNOWN = Unknown()
+
+
+@dataclass(eq=False)
+class ChannelVal:
+    """One channel creation site, the graph's edge-carrier."""
+
+    cid: str  # stable id: "role/name" for metered, "Owner.attr" otherwise
+    label: str
+    capacity: object  # int | "default" | "?"
+    path: str  # repo-relative posix path of the creation site
+    line: int
+
+    def __repr__(self):
+        return f"Channel<{self.cid}>"
+
+
+@dataclass(eq=False)
+class WatchVal:
+    """channels.Watch / channels.Subscriber — broadcast state, not an edge."""
+
+
+@dataclass(eq=False)
+class ObjectVal:
+    cls: "ClassInfo"
+    ipath: str  # deterministic instance path, e.g. "PrimaryNode.primary"
+    attrs: dict = field(default_factory=dict)
+
+    def __repr__(self):
+        return f"Object<{self.ipath}>"
+
+
+@dataclass(eq=False)
+class BoundMethodVal:
+    obj: ObjectVal
+    name: str
+
+
+@dataclass(eq=False)
+class BoundChannelMethod:
+    channel: ChannelVal
+    name: str  # send | send_many | try_send | recv | try_recv
+
+
+@dataclass(eq=False)
+class BoundCollectionMethod:
+    """`.items()`/`.values()`/`.append(x)`... on a modeled collection."""
+
+    coll: object  # CollectionVal | DictVal
+    name: str
+
+
+@dataclass(eq=False)
+class CoroutineVal:
+    """An async call not yet awaited: its body runs when awaited (same
+    task) or spawned (new task)."""
+
+    target: object  # BoundMethodVal | LocalFuncVal | FuncInfo
+    args: list
+    kwargs: dict
+    consumed: bool = False
+
+
+@dataclass(eq=False)
+class LocalFuncVal:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    env: dict  # closure snapshot
+    owner: object  # ObjectVal | None
+    module: "ModuleInfo"
+    qual: str
+
+
+@dataclass(eq=False)
+class CollectionVal:
+    kind: str  # list | tuple | set
+    items: list
+
+
+@dataclass(eq=False)
+class DictVal:
+    keys: list
+    values: list
+
+
+class UnionVal:
+    """Join of alternative branch values; ops/lookups map over members."""
+
+    def __init__(self, members: Iterable):
+        flat = []
+        for m in members:
+            if isinstance(m, UnionVal):
+                for mm in m.members:
+                    if mm not in flat:
+                        flat.append(mm)
+            elif m is not None and m is not UNKNOWN and m not in flat:
+                flat.append(m)
+        self.members = flat
+
+
+def join(*values):
+    u = UnionVal(values)
+    if not u.members:
+        return UNKNOWN
+    if len(u.members) == 1:
+        return u.members[0]
+    return u
+
+
+def members_of(value) -> list:
+    if isinstance(value, UnionVal):
+        return value.members
+    if value is UNKNOWN or value is None:
+        return []
+    return [value]
+
+
+# ---------------------------------------------------------------------------
+# Program model: modules, classes, functions
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    module: "ModuleInfo"
+    node: ast.AST
+    qual: str
+
+
+@dataclass
+class ClassInfo:
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    name: str
+    methods: dict = field(default_factory=dict)
+
+    def method(self, name: str):
+        return self.methods.get(name)
+
+
+@dataclass
+class ModuleInfo:
+    rel: str  # repo-relative posix path
+    dotted: str  # e.g. narwhal_tpu.primary.core
+    tree: ast.Module
+    lines: list
+    classes: dict = field(default_factory=dict)
+    functions: dict = field(default_factory=dict)
+    aliases: dict = field(default_factory=dict)  # local name -> full dotted
+
+
+def _module_dotted(rel: Path) -> str:
+    parts = list(rel.with_suffix("").parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class Program:
+    """All parsed modules of one package, with name resolution."""
+
+    def __init__(self, root: Path, package_dir: Path | None):
+        self.root = Path(root)
+        self.modules: dict[str, ModuleInfo] = {}
+        if package_dir is not None and package_dir.is_dir():
+            for path in sorted(package_dir.rglob("*.py")):
+                if "__pycache__" in path.parts or path.name.endswith("_pb2.py"):
+                    continue
+                self.load(path)
+
+    def load(self, path: Path) -> ModuleInfo | None:
+        path = Path(path)
+        try:
+            rel = path.resolve().relative_to(self.root.resolve())
+        except ValueError:
+            rel = Path(path.name)
+        dotted = _module_dotted(rel)
+        if dotted in self.modules:
+            return self.modules[dotted]
+        try:
+            source = path.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(path))
+        except (OSError, SyntaxError):
+            return None
+        info = ModuleInfo(rel.as_posix(), dotted, tree, source.splitlines())
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                ci = ClassInfo(info, node, node.name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        ci.methods[item.name] = item
+                info.classes[node.name] = ci
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.functions[node.name] = FuncInfo(info, node, f"{dotted}.{node.name}")
+        info.aliases = self._aliases(info, rel)
+        self.modules[dotted] = info
+        return info
+
+    def _aliases(self, info: ModuleInfo, rel: Path) -> dict:
+        """Local name -> absolute dotted origin, with relative imports
+        normalized against the importing module's package."""
+        pkg_parts = info.dotted.split(".") if info.dotted else []
+        if rel.name != "__init__.py" and pkg_parts:
+            pkg_parts = pkg_parts[:-1]
+        out: dict[str, str] = {}
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    out[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
+        return out
+
+    def resolve_symbol(self, dotted: str, depth: int = 0):
+        """A full dotted symbol -> ClassInfo | FuncInfo | None, following
+        package-__init__ re-export chains (narwhal_tpu.consensus.Dag ->
+        narwhal_tpu.consensus.dag.Dag)."""
+        if depth > 4 or "." not in dotted:
+            return None
+        mod_name, _, sym = dotted.rpartition(".")
+        info = self.modules.get(mod_name)
+        if info is None:
+            return None
+        if sym in info.classes:
+            return info.classes[sym]
+        if sym in info.functions:
+            return info.functions[sym]
+        reexport = info.aliases.get(sym)
+        if reexport:
+            return self.resolve_symbol(reexport, depth + 1)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Topology
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Op:
+    task: str
+    channel: str  # cid
+    kind: str  # send | send_many | try_send | recv | try_recv
+    path: str
+    line: int
+
+    @property
+    def is_send(self) -> bool:
+        return self.kind in ("send", "send_many", "try_send")
+
+    @property
+    def blocking(self) -> bool:
+        return self.kind in ("send", "send_many", "recv")
+
+
+class Topology:
+    def __init__(self):
+        self.channels: dict[str, ChannelVal] = {}
+        self.ops: list[Op] = []
+        self.tasks: set[str] = set()
+        self._op_seen: set = set()
+
+    def add_channel(self, ch: ChannelVal) -> None:
+        self.channels.setdefault(ch.cid, ch)
+
+    def record(self, task, channel, kind, path, line) -> None:
+        key = (task, channel, kind, path, line)
+        if key not in self._op_seen:
+            self._op_seen.add(key)
+            self.ops.append(Op(task, channel, kind, path, line))
+        self.tasks.add(task)
+
+    def live_channels(self) -> dict[str, ChannelVal]:
+        """Channels with at least one op — creation sites discarded by a
+        conditional arm never show up here."""
+        used = {o.channel for o in self.ops}
+        return {cid: ch for cid, ch in self.channels.items() if cid in used}
+
+    # -- queries used by the detectors ---------------------------------
+    def senders(self, cid: str) -> list[Op]:
+        return [o for o in self.ops if o.channel == cid and o.is_send]
+
+    def receivers(self, cid: str) -> list[Op]:
+        return [o for o in self.ops if o.channel == cid and not o.is_send]
+
+    def wait_graph(self) -> dict[str, set[str]]:
+        """Directed wait-for graph for deadlock cycles: task -> channel on
+        a *blocking* send (the task can block with the item in hand);
+        channel -> task for each task that receives from it (the channel
+        drains only while that task makes progress)."""
+        g: dict[str, set[str]] = {}
+        for op in self.ops:
+            if op.is_send and op.blocking:
+                g.setdefault(f"task:{op.task}", set()).add(f"chan:{op.channel}")
+            elif not op.is_send:
+                g.setdefault(f"chan:{op.channel}", set()).add(f"task:{op.task}")
+        return g
+
+
+# ---------------------------------------------------------------------------
+# The interpreter
+# ---------------------------------------------------------------------------
+
+_CHANNEL_SENDS = {"send", "send_many", "try_send"}
+_CHANNEL_RECVS = {"recv", "try_recv"}
+_CHANNEL_OPS = _CHANNEL_SENDS | _CHANNEL_RECVS
+_AWAIT_COMBINATORS = {"gather", "wait_for", "shield"}
+
+MAX_DEPTH = 60
+MAX_INSTANCES = 500
+
+
+class Extractor:
+    def __init__(self, program: Program):
+        self.program = program
+        self.topology = Topology()
+        self._instance_count: dict[str, int] = {}
+        self._visited: set = set()
+        self._class_stack: list[str] = []
+        self._pending_roots: list = []
+        self._root_seen: set = set()
+        self._coroutines: list[CoroutineVal] = []
+        self._local_stack: list = []
+        self._anon_chan = 0
+        self.instances: list[ObjectVal] = []
+
+    # -- public entry points -------------------------------------------
+    def run_class_root(self, cls: ClassInfo) -> ObjectVal:
+        obj = self.instantiate(cls, [], {}, hint=cls.name)
+        for lifecycle in ("spawn", "run", "shutdown", "stop", "close"):
+            if isinstance(obj, ObjectVal) and cls.method(lifecycle):
+                self._queue_root(
+                    f"{obj.ipath}.{lifecycle}", BoundMethodVal(obj, lifecycle)
+                )
+        self._drain_roots()
+        return obj
+
+    def run_function_root(self, func: FuncInfo) -> None:
+        self._queue_root(func.qual.split(".")[-1], func)
+        self._drain_roots()
+
+    def _queue_root(self, name: str, target, coro: CoroutineVal | None = None):
+        # Same instance+method spawned from several sites is ONE logical
+        # task: walk it once so the topology stays canonical.
+        if name in self._root_seen:
+            return
+        self._root_seen.add(name)
+        self._pending_roots.append((name, target, coro))
+
+    def _drain_roots(self) -> None:
+        while True:
+            while self._pending_roots:
+                name, target, coro = self._pending_roots.pop(0)
+                args = coro.args if coro is not None else []
+                kwargs = coro.kwargs if coro is not None else {}
+                self._call_target(name, target, args, kwargs)
+            # Safety net: coroutines handed to unknown sinks (bounded
+            # future pools etc.) run as their own tasks.
+            leftovers = [c for c in self._coroutines if not c.consumed]
+            if not leftovers:
+                return
+            for c in leftovers:
+                c.consumed = True
+                self._spawn_task(c)
+
+    # -- instantiation --------------------------------------------------
+    def instantiate(self, cls: ClassInfo, args, kwargs, hint: str | None = None):
+        if cls.name in self._class_stack or len(self._class_stack) > 12:
+            return UNKNOWN
+        n = self._instance_count.get(cls.name, 0)
+        self._instance_count[cls.name] = n + 1
+        if sum(self._instance_count.values()) > MAX_INSTANCES:
+            return UNKNOWN
+        ipath = cls.name if n == 0 else f"{cls.name}#{n}"
+        obj = ObjectVal(cls, ipath)
+        self.instances.append(obj)
+        init = cls.method("__init__")
+        if init is not None:
+            env = self._bind(init, [obj] + list(args), kwargs)
+            self._class_stack.append(cls.name)
+            try:
+                self._exec_body(
+                    init.body, env, cls.module, f"init:{ipath}", obj, 0
+                )
+            finally:
+                self._class_stack.pop()
+        return obj
+
+    def _bind(self, func_node, args, kwargs) -> dict:
+        env: dict = {}
+        a = func_node.args
+        params = [p.arg for p in a.args]
+        for name, val in zip(params, args):
+            env[name] = val
+        params += [p.arg for p in a.kwonlyargs]
+        for k, v in kwargs.items():
+            if k in params:
+                env[k] = v
+        for p in params:
+            env.setdefault(p, UNKNOWN)
+        return env
+
+    # -- statement execution -------------------------------------------
+    def _exec_body(self, body, env, module, ctx, selfobj, depth) -> None:
+        if depth > MAX_DEPTH:
+            return
+        for stmt in body:
+            self._exec_stmt(stmt, env, module, ctx, selfobj, depth)
+
+    def _exec_stmt(self, stmt, env, module, ctx, selfobj, depth) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[stmt.name] = LocalFuncVal(
+                stmt, dict(env), selfobj, module, f"{ctx}.{stmt.name}"
+            )
+        elif isinstance(stmt, ast.Assign):
+            value = self._eval(
+                stmt.value, env, module, ctx, selfobj, depth,
+                hint=self._target_hint(stmt.targets),
+            )
+            for t in stmt.targets:
+                self._assign(t, value, env, module, ctx, selfobj, depth)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            value = self._eval(
+                stmt.value, env, module, ctx, selfobj, depth,
+                hint=self._target_hint([stmt.target]),
+            )
+            self._assign(stmt.target, value, env, module, ctx, selfobj, depth)
+        elif isinstance(stmt, ast.AugAssign):
+            self._eval(stmt.value, env, module, ctx, selfobj, depth)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value, env, module, ctx, selfobj, depth)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                v = self._eval(stmt.value, env, module, ctx, selfobj, depth)
+                env["__return__"] = env.get("__return__", []) + [v]
+        elif isinstance(stmt, ast.If):
+            self._eval(stmt.test, env, module, ctx, selfobj, depth)
+            then_env, else_env = dict(env), dict(env)
+            self._exec_body(stmt.body, then_env, module, ctx, selfobj, depth + 1)
+            self._exec_body(stmt.orelse, else_env, module, ctx, selfobj, depth + 1)
+            self._merge_env(env, then_env, else_env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self._eval(stmt.iter, env, module, ctx, selfobj, depth)
+            self._bind_loop_target(stmt.target, it, env)
+            self._exec_body(stmt.body, env, module, ctx, selfobj, depth + 1)
+            self._exec_body(stmt.orelse, env, module, ctx, selfobj, depth + 1)
+        elif isinstance(stmt, ast.While):
+            self._eval(stmt.test, env, module, ctx, selfobj, depth)
+            self._exec_body(stmt.body, env, module, ctx, selfobj, depth + 1)
+            self._exec_body(stmt.orelse, env, module, ctx, selfobj, depth + 1)
+        elif isinstance(stmt, ast.Try):
+            self._exec_body(stmt.body, env, module, ctx, selfobj, depth + 1)
+            for h in stmt.handlers:
+                self._exec_body(h.body, env, module, ctx, selfobj, depth + 1)
+            self._exec_body(stmt.orelse, env, module, ctx, selfobj, depth + 1)
+            self._exec_body(stmt.finalbody, env, module, ctx, selfobj, depth + 1)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval(item.context_expr, env, module, ctx, selfobj, depth)
+            self._exec_body(stmt.body, env, module, ctx, selfobj, depth + 1)
+        elif isinstance(stmt, ast.Raise) and stmt.exc is not None:
+            self._eval(stmt.exc, env, module, ctx, selfobj, depth)
+
+    def _target_hint(self, targets) -> str | None:
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                return t.attr
+            if isinstance(t, ast.Name):
+                return t.id
+        return None
+
+    def _merge_env(self, base, a, b) -> None:
+        for k in set(a) | set(b):
+            va, vb = a.get(k, UNKNOWN), b.get(k, UNKNOWN)
+            if k == "__return__":
+                # The return accumulator is a plain list, not a value.
+                merged = []
+                for branch in (va, vb):
+                    if isinstance(branch, list):
+                        merged.extend(branch)
+                base[k] = merged
+            else:
+                base[k] = va if va is vb else join(va, vb)
+
+    def _assign(self, target, value, env, module, ctx, selfobj, depth) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = value
+        elif isinstance(target, ast.Attribute):
+            recv = self._eval(target.value, env, module, ctx, selfobj, depth)
+            for obj in members_of(recv):
+                if isinstance(obj, ObjectVal):
+                    prev = obj.attrs.get(target.attr)
+                    obj.attrs[target.attr] = (
+                        value if prev is None else join(prev, value)
+                    )
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            items = None
+            for v in members_of(value):
+                if isinstance(v, CollectionVal):
+                    items = v.items
+            for i, el in enumerate(target.elts):
+                item = items[i] if items and i < len(items) else UNKNOWN
+                self._assign(el, item, env, module, ctx, selfobj, depth)
+        elif isinstance(target, ast.Subscript):
+            recv = self._eval(target.value, env, module, ctx, selfobj, depth)
+            for c in members_of(recv):
+                if isinstance(c, CollectionVal):
+                    c.items.append(value)
+                elif isinstance(c, DictVal):
+                    c.values.append(value)
+
+    def _bind_loop_target(self, target, iterable, env) -> None:
+        """`for k, v in d.items()` / `for x in xs` value flow."""
+        element = UNKNOWN
+        pair = None
+        for v in members_of(iterable):
+            if isinstance(v, CollectionVal):
+                element = join(element, *v.items)
+            elif isinstance(v, DictVal):
+                pair = (
+                    join(*v.keys) if v.keys else UNKNOWN,
+                    join(*v.values) if v.values else UNKNOWN,
+                )
+                element = join(element, *(v.keys or []))
+        if isinstance(target, ast.Name):
+            env[target.id] = element
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for i, el in enumerate(target.elts):
+                if isinstance(el, ast.Name):
+                    if pair is not None and i < 2:
+                        env[el.id] = pair[i]
+                    else:
+                        env[el.id] = UNKNOWN
+
+    # -- expression evaluation -----------------------------------------
+    def _eval(self, node, env, module, ctx, selfobj, depth, hint=None):
+        if depth > MAX_DEPTH:
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            return self._module_symbol(node.id, module)
+        if isinstance(node, ast.Attribute):
+            recv = self._eval(node.value, env, module, ctx, selfobj, depth)
+            return self._attr(recv, node.attr)
+        if isinstance(node, ast.Call):
+            return self._call(node, env, module, ctx, selfobj, depth, hint)
+        if isinstance(node, ast.Await):
+            v = self._eval(node.value, env, module, ctx, selfobj, depth, hint)
+            return self._consume_coroutine(v, ctx, depth)
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, module, ctx, selfobj, depth)
+            a = self._eval(node.body, env, module, ctx, selfobj, depth, hint)
+            b = self._eval(node.orelse, env, module, ctx, selfobj, depth, hint)
+            # Alternative constructions of the same logical channel: keep
+            # the first channel-valued arm as THE creation site.
+            for v in (a, b):
+                for m in members_of(v):
+                    if isinstance(m, ChannelVal):
+                        return m
+            return join(a, b)
+        if isinstance(node, ast.BoolOp):
+            return join(
+                *(
+                    self._eval(v, env, module, ctx, selfobj, depth, hint)
+                    for v in node.values
+                )
+            )
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            kind = type(node).__name__.lower()
+            items = []
+            for e in node.elts:
+                v = self._eval(
+                    e.value if isinstance(e, ast.Starred) else e,
+                    env, module, ctx, selfobj, depth,
+                )
+                if isinstance(e, ast.Starred):
+                    for c in members_of(v):
+                        if isinstance(c, CollectionVal):
+                            items.extend(c.items)
+                else:
+                    items.append(v)
+            return CollectionVal(kind, items)
+        if isinstance(node, ast.Dict):
+            return DictVal(
+                [
+                    self._eval(k, env, module, ctx, selfobj, depth)
+                    for k in node.keys
+                    if k is not None
+                ],
+                [self._eval(v, env, module, ctx, selfobj, depth) for v in node.values],
+            )
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return CollectionVal(
+                "list", [self._eval_comp(node, env, module, ctx, selfobj, depth)]
+            )
+        if isinstance(node, ast.DictComp):
+            cenv = dict(env)
+            for gen in node.generators:
+                it = self._eval(gen.iter, cenv, module, ctx, selfobj, depth)
+                self._bind_loop_target(gen.target, it, cenv)
+            return DictVal(
+                [self._eval(node.key, cenv, module, ctx, selfobj, depth)],
+                [self._eval(node.value, cenv, module, ctx, selfobj, depth)],
+            )
+        if isinstance(node, ast.Subscript):
+            recv = self._eval(node.value, env, module, ctx, selfobj, depth)
+            self._eval(node.slice, env, module, ctx, selfobj, depth)
+            out = UNKNOWN
+            for c in members_of(recv):
+                if isinstance(c, CollectionVal):
+                    out = join(out, *c.items)
+                elif isinstance(c, DictVal):
+                    out = join(out, *c.values)
+            return out
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.NamedExpr):
+            v = self._eval(node.value, env, module, ctx, selfobj, depth, hint)
+            self._assign(node.target, v, env, module, ctx, selfobj, depth)
+            return v
+        if isinstance(node, (ast.Compare, ast.UnaryOp, ast.BinOp)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._eval(child, env, module, ctx, selfobj, depth)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _eval_comp(self, node, env, module, ctx, selfobj, depth):
+        cenv = dict(env)
+        for gen in node.generators:
+            it = self._eval(gen.iter, cenv, module, ctx, selfobj, depth)
+            self._bind_loop_target(gen.target, it, cenv)
+            for cond in gen.ifs:
+                self._eval(cond, cenv, module, ctx, selfobj, depth)
+        return self._eval(node.elt, cenv, module, ctx, selfobj, depth)
+
+    def _module_symbol(self, name: str, module: ModuleInfo):
+        origin = module.aliases.get(name)
+        if origin is not None:
+            resolved = self.program.resolve_symbol(origin)
+            if resolved is not None:
+                return resolved
+            return origin  # dotted module marker (e.g. "asyncio")
+        if name in module.classes:
+            return module.classes[name]
+        if name in module.functions:
+            return module.functions[name]
+        return UNKNOWN
+
+    def _attr(self, recv, attr: str):
+        out = []
+        for v in members_of(recv):
+            if isinstance(v, ChannelVal):
+                if attr in _CHANNEL_OPS:
+                    out.append(BoundChannelMethod(v, attr))
+            elif isinstance(v, ObjectVal):
+                if attr in v.attrs:
+                    out.append(v.attrs[attr])
+                elif v.cls.method(attr) is not None:
+                    out.append(BoundMethodVal(v, attr))
+            elif isinstance(v, (CollectionVal, DictVal)):
+                out.append(BoundCollectionMethod(v, attr))
+            elif isinstance(v, str):  # dotted module marker
+                dotted = f"{v}.{attr}"
+                resolved = self.program.resolve_symbol(dotted)
+                out.append(resolved if resolved is not None else dotted)
+        if not out:
+            return UNKNOWN
+        return join(*out)
+
+    # -- calls ----------------------------------------------------------
+    def _dotted_name(self, node) -> str | None:
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def _call(self, node: ast.Call, env, module, ctx, selfobj, depth, hint=None):
+        raw = self._dotted_name(node.func)
+        resolved_raw = None
+        if raw is not None and raw.split(".")[0] not in env:
+            head, _, rest = raw.partition(".")
+            origin = module.aliases.get(head, head)
+            resolved_raw = f"{origin}.{rest}" if rest else origin
+
+        # -- the sanctioned channel wrappers (channels.py) --------------
+        if resolved_raw is not None:
+            if resolved_raw == "metered_channel" or resolved_raw.endswith(
+                "channels.metered_channel"
+            ):
+                return self._make_channel(
+                    node, env, module, ctx, selfobj, depth, metered=True, hint=hint
+                )
+            if resolved_raw == "Channel" or resolved_raw.endswith(
+                "channels.Channel"
+            ):
+                return self._make_channel(
+                    node, env, module, ctx, selfobj, depth, metered=False, hint=hint
+                )
+            if (
+                resolved_raw.endswith(("channels.Watch", "channels.Subscriber"))
+                or resolved_raw == "Watch"
+            ):
+                for a in node.args:
+                    self._eval(a, env, module, ctx, selfobj, depth)
+                return WatchVal()
+
+        # -- task spawns ------------------------------------------------
+        if resolved_raw is not None and resolved_raw.split(".")[-1] in (
+            "ensure_future",
+            "create_task",
+        ):
+            for a in node.args:
+                inner = self._eval(a, env, module, ctx, selfobj, depth)
+                for v in members_of(inner):
+                    if isinstance(v, CoroutineVal) and not v.consumed:
+                        v.consumed = True
+                        self._spawn_task(v)
+            return UNKNOWN
+
+        func_val = self._eval(node.func, env, module, ctx, selfobj, depth)
+        args = []
+        for a in node.args:
+            v = self._eval(
+                a.value if isinstance(a, ast.Starred) else a,
+                env, module, ctx, selfobj, depth,
+            )
+            if isinstance(a, ast.Starred):
+                for c in members_of(v):
+                    if isinstance(c, CollectionVal):
+                        args.extend(c.items)
+            else:
+                args.append(v)
+        kwargs = {
+            kw.arg: self._eval(kw.value, env, module, ctx, selfobj, depth)
+            for kw in node.keywords
+            if kw.arg is not None
+        }
+
+        # Bound methods passed as callbacks (route handlers, done
+        # callbacks, hooks) become task roots of their own.
+        for v in list(args) + list(kwargs.values()):
+            for m in members_of(v):
+                if isinstance(m, BoundMethodVal):
+                    self._queue_root(f"cb:{m.obj.ipath}.{m.name}", m)
+
+        results = [
+            self._apply(fv, node, args, kwargs, module, ctx, depth, hint)
+            for fv in members_of(func_val)
+        ]
+
+        # Awaited combinators run their coroutine args on this task.
+        if (
+            resolved_raw is not None
+            and resolved_raw.split(".")[-1] in _AWAIT_COMBINATORS
+        ):
+            for v in args:
+                for m in members_of(v):
+                    if isinstance(m, CoroutineVal):
+                        self._consume_coroutine(m, ctx, depth)
+                    elif isinstance(m, CollectionVal):
+                        for item in m.items:
+                            self._consume_coroutine(item, ctx, depth)
+        return join(*results) if results else UNKNOWN
+
+    def _apply(self, fv, node, args, kwargs, module, ctx, depth, hint=None):
+        if isinstance(fv, BoundChannelMethod):
+            self.topology.record(
+                _task_name(ctx), fv.channel.cid, fv.name, module.rel, node.lineno
+            )
+            return UNKNOWN
+        if isinstance(fv, BoundCollectionMethod):
+            return self._collection_call(fv, args)
+        if isinstance(fv, ClassInfo):
+            return self.instantiate(fv, args, kwargs, hint=hint)
+        if isinstance(fv, FuncInfo):
+            if isinstance(fv.node, ast.AsyncFunctionDef):
+                return self._coro(fv, args, kwargs)
+            return self._walk_function(
+                fv.node, fv.module, None, args, kwargs, ctx, depth, qual=fv.qual
+            )
+        if isinstance(fv, LocalFuncVal):
+            if isinstance(fv.node, ast.AsyncFunctionDef):
+                return self._coro(fv, args, kwargs)
+            # Local sync helpers (the `chan(name, capacity)` factories)
+            # are walked per call — each call creates a distinct channel —
+            # with a stack guard instead of the visited set.
+            return self._walk_function(
+                fv.node, fv.module, fv.owner, args, kwargs, ctx, depth,
+                closure=fv.env, qual=fv.qual, per_call=True,
+            )
+        if isinstance(fv, BoundMethodVal):
+            method = fv.obj.cls.method(fv.name)
+            if method is None:
+                return UNKNOWN
+            if isinstance(method, ast.AsyncFunctionDef):
+                return self._coro(fv, args, kwargs)
+            return self._walk_method(fv.obj, fv.name, args, kwargs, ctx, depth)
+        return UNKNOWN
+
+    def _collection_call(self, fv: BoundCollectionMethod, args):
+        c, name = fv.coll, fv.name
+        if isinstance(c, DictVal):
+            if name == "items":
+                return c  # loop targets unpack DictVal into (key, value)
+            if name == "keys":
+                return CollectionVal("list", list(c.keys))
+            if name in ("values", "pop", "get", "setdefault", "popitem"):
+                return CollectionVal("list", list(c.values))
+        if isinstance(c, CollectionVal):
+            if name in ("append", "add"):
+                c.items.extend(args)
+                return None
+            if name == "extend" and args:
+                for v in members_of(args[0]):
+                    if isinstance(v, CollectionVal):
+                        c.items.extend(v.items)
+                return None
+            if name == "pop":
+                return join(*c.items) if c.items else UNKNOWN
+            if name == "copy":
+                return c
+        return UNKNOWN
+
+    def _coro(self, target, args, kwargs) -> CoroutineVal:
+        c = CoroutineVal(target, args, kwargs)
+        self._coroutines.append(c)
+        return c
+
+    def _consume_coroutine(self, v, ctx, depth):
+        out = UNKNOWN
+        consumed = False
+        for m in members_of(v):
+            if isinstance(m, CoroutineVal) and not m.consumed:
+                m.consumed = True
+                consumed = True
+                out = join(out, self._run_coroutine(m, ctx, depth))
+        return out if consumed else v
+
+    def _run_coroutine(self, coro: CoroutineVal, ctx, depth):
+        t = coro.target
+        if isinstance(t, BoundMethodVal):
+            return self._walk_method(t.obj, t.name, coro.args, coro.kwargs, ctx, depth)
+        if isinstance(t, LocalFuncVal):
+            return self._walk_function(
+                t.node, t.module, t.owner, coro.args, coro.kwargs, ctx, depth,
+                closure=t.env, qual=t.qual,
+            )
+        if isinstance(t, FuncInfo):
+            return self._walk_function(
+                t.node, t.module, None, coro.args, coro.kwargs, ctx, depth,
+                qual=t.qual,
+            )
+        return UNKNOWN
+
+    def _spawn_task(self, coro: CoroutineVal) -> None:
+        t = coro.target
+        if isinstance(t, BoundMethodVal):
+            name = f"{t.obj.ipath}.{t.name}"
+        elif isinstance(t, (LocalFuncVal, FuncInfo)):
+            name = _short_qual(t.qual)
+        else:
+            return
+        self._queue_root(f"task:{name}", t, coro)
+
+    def _call_target(self, task_name, target, args, kwargs) -> None:
+        if isinstance(target, BoundMethodVal):
+            method = target.obj.cls.method(target.name)
+            if method is None:
+                return
+            self._walk_method(
+                target.obj, target.name, args, kwargs, task_name, 0, force=True
+            )
+        elif isinstance(target, LocalFuncVal):
+            self._walk_function(
+                target.node, target.module, target.owner, args, kwargs,
+                task_name, 0, closure=target.env, qual=target.qual, force=True,
+            )
+        elif isinstance(target, FuncInfo):
+            self._walk_function(
+                target.node, target.module, None, args, kwargs, task_name, 0,
+                qual=target.qual, force=True,
+            )
+
+    # -- function/method walking ---------------------------------------
+    def _walk_method(self, obj, name, args, kwargs, ctx, depth, force=False):
+        method = obj.cls.method(name)
+        if method is None:
+            return UNKNOWN
+        key = (ctx, obj.ipath, name)
+        if key in self._visited and not force:
+            return UNKNOWN
+        self._visited.add(key)
+        env = self._bind(method, [obj] + list(args), kwargs)
+        self._exec_body(method.body, env, obj.cls.module, ctx, obj, depth + 1)
+        rets = env.get("__return__", [])
+        return join(*rets) if rets else UNKNOWN
+
+    def _walk_function(self, func_node, module, owner, args, kwargs, ctx, depth,
+                       closure=None, qual="", force=False, per_call=False):
+        key = (ctx, qual or id(func_node))
+        if per_call:
+            if key in self._local_stack:  # recursion guard
+                return UNKNOWN
+            self._local_stack.append(key)
+        elif key in self._visited and not force:
+            return UNKNOWN
+        else:
+            self._visited.add(key)
+        try:
+            env = dict(closure or {})
+            env.pop("__return__", None)
+            env.update(self._bind(func_node, args, kwargs))
+            self._exec_body(func_node.body, env, module, ctx, owner, depth + 1)
+            rets = env.get("__return__", [])
+            return join(*rets) if rets else UNKNOWN
+        finally:
+            if per_call:
+                self._local_stack.pop()
+
+    # -- channels -------------------------------------------------------
+    def _make_channel(self, node, env, module, ctx, selfobj, depth, metered,
+                      hint=None) -> ChannelVal:
+        args = [self._eval(a, env, module, ctx, selfobj, depth) for a in node.args]
+        kwargs = {
+            kw.arg: self._eval(kw.value, env, module, ctx, selfobj, depth)
+            for kw in node.keywords
+            if kw.arg is not None
+        }
+        label = None
+        if metered:
+            role = args[1] if len(args) > 1 else kwargs.get("role", UNKNOWN)
+            name = args[2] if len(args) > 2 else kwargs.get("name", UNKNOWN)
+            capacity = args[3] if len(args) > 3 else kwargs.get("capacity", UNKNOWN)
+            if isinstance(role, str) and isinstance(name, str):
+                label = f"{role}/{name}"
+        else:
+            capacity = args[0] if args else kwargs.get("capacity", "default")
+        if label is None:
+            owner = selfobj.ipath if isinstance(selfobj, ObjectVal) else _task_name(ctx)
+            attr = hint
+            if attr is None:
+                attr = f"anon{self._anon_chan}"
+                self._anon_chan += 1
+            label = f"{owner}.{attr}"
+        if not isinstance(capacity, int):
+            capacity = "default" if capacity in (None, UNKNOWN) else "?"
+        cid, i = label, 2
+        while cid in self.topology.channels:
+            existing = self.topology.channels[cid]
+            if (existing.path, existing.line) == (module.rel, node.lineno):
+                return existing
+            cid = f"{label}#{i}"
+            i += 1
+        ch = ChannelVal(cid, label, capacity, module.rel, node.lineno)
+        self.topology.add_channel(ch)
+        return ch
+
+
+def _task_name(ctx: str) -> str:
+    return ctx[5:] if ctx.startswith("task:") else ctx
+
+
+def _short_qual(qual: str) -> str:
+    """Strip root-context prefixes from nested-function quals:
+    'task:Subscriber.run.forward' -> 'Subscriber.run.forward'."""
+    return qual[5:] if qual.startswith("task:") else qual
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RootSpec:
+    """`path/to/module.py::Symbol` — a class (instantiated, with its
+    lifecycle methods seeded) or a module-level function (walked as the
+    embedder's task)."""
+
+    path: str
+    symbol: str
+
+    @classmethod
+    def parse(cls, spec: str) -> "RootSpec":
+        path, _, symbol = spec.partition("::")
+        if not symbol:
+            raise ValueError(f"root spec {spec!r} needs 'file.py::Symbol'")
+        return cls(path, symbol)
+
+
+DEFAULT_PACKAGE = "narwhal_tpu"
+DEFAULT_ROOTS = (
+    # The role binary wires every production actor: PrimaryNode (internal
+    # AND external consensus — both `if` arms execute), WorkerNode, and
+    # the standalone primary's execution-output drain.
+    "narwhal_tpu/__main__.py::_run_node",
+)
+
+
+def extract(
+    root: Path,
+    package: str = DEFAULT_PACKAGE,
+    roots: Iterable[str] = DEFAULT_ROOTS,
+) -> tuple[Topology, Extractor]:
+    """Parse `package` under `root`, interpret the wiring from `roots`,
+    and return the channel topology."""
+    root = Path(root)
+    pkg_dir = root / package if package else None
+    program = Program(root, pkg_dir)
+    extractor = Extractor(program)
+    for spec in roots:
+        rs = RootSpec.parse(spec)
+        info = program.load(root / rs.path)
+        if info is None:
+            raise FileNotFoundError(rs.path)
+        if rs.symbol in info.classes:
+            extractor.run_class_root(info.classes[rs.symbol])
+        elif rs.symbol in info.functions:
+            extractor.run_function_root(info.functions[rs.symbol])
+        else:
+            raise ValueError(f"{rs.symbol} not found in {rs.path}")
+    return extractor.topology, extractor

@@ -1,0 +1,372 @@
+"""Cross-module jit-purity: the whole-package call graph under jax.jit.
+
+narwhal-lint's `jit-purity` rule BFSes from `@jax.jit` roots through the
+*same module's* call graph. That caveat was load-bearing: a kernel in
+`tpu/verifier.py` that imports a helper from `tpu/ed25519.py` gets no
+purity checking past the import — yet an impure helper (print, host RNG,
+module-global mutation) behaves identically badly whether it lives one
+module over or not: it runs once at trace time, then is baked into or
+elided from every later dispatch of the compiled kernel.
+
+This module builds the call graph across sibling modules (resolving
+`from .ed25519 import foo` / `from . import ed25519; ed25519.foo(...)`)
+and runs the same impurity checks on every reachable function. It is the
+shared engine behind BOTH gates:
+
+- `tools.lint.rules.JitPurity` calls `module_purity` while scanning a
+  module in `tpu/`, yielding the cross-module findings its same-module
+  BFS used to miss;
+- `tools.analysis`'s `cross-module-jit-purity` detector calls
+  `package_purity` over the whole `tpu/` package.
+
+Kept dependency-free of tools.lint so the two packages can import each
+other's leaves without a cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Za-z0-9_\-*,\s]+)\)")
+
+_IMPURE_MODULES = {"time", "random"}
+_IMPURE_CALLS = {"print", "input"}
+_JIT_NAMES = {"jax.jit", "jit"}
+
+
+@dataclass
+class Impurity:
+    path: str  # repo-relative posix path of the impure site
+    line: int
+    col: int
+    snippet: str
+    message: str
+    func: str
+    root: str  # the jit root function name
+    root_path: str  # module the root lives in
+    cross_module: bool
+    allowed_rules: set = field(default_factory=set)  # inline allows at site
+
+
+@dataclass
+class _Mod:
+    path: Path
+    rel: str
+    tree: ast.Module
+    lines: list
+    funcs: dict  # bare name -> ast def (module functions AND methods)
+    aliases: dict  # local name -> dotted origin (as written)
+    globals_: set
+
+
+def _load(path: Path, root: Path) -> _Mod | None:
+    try:
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+    try:
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    funcs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    aliases: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and (node.module or node.level):
+            mod = node.module or ""
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{mod}.{a.name}" if mod else a.name
+    globals_ = {
+        t.id
+        for stmt in tree.body
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+        for t in (stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target])
+        if isinstance(t, ast.Name)
+    }
+    return _Mod(path, rel, tree, source.splitlines(), funcs, aliases, globals_)
+
+
+def _dotted(node) -> str | None:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _resolve(node, aliases) -> str | None:
+    d = _dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    origin = aliases.get(head)
+    if origin is None:
+        return d
+    return f"{origin}.{rest}" if rest else origin
+
+
+def _jit_roots(mod: _Mod) -> set:
+    roots: set = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                d = _resolve(deco, mod.aliases)
+                if d in _JIT_NAMES:
+                    roots.add(node.name)
+                elif isinstance(deco, ast.Call):
+                    f = _resolve(deco.func, mod.aliases)
+                    if f in _JIT_NAMES:
+                        roots.add(node.name)
+                    elif f in ("partial", "functools.partial") and deco.args:
+                        if _resolve(deco.args[0], mod.aliases) in _JIT_NAMES:
+                            roots.add(node.name)
+        elif isinstance(node, ast.Call):
+            if _resolve(node.func, mod.aliases) in _JIT_NAMES and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in mod.funcs:
+                    roots.add(arg.id)
+    return roots
+
+
+class _Package:
+    """Sibling modules of one directory, linked by imports."""
+
+    def __init__(self, files, root: Path):
+        self.root = root
+        self.mods: dict[str, _Mod] = {}  # module basename -> _Mod
+        for f in files:
+            m = _load(Path(f), root)
+            if m is not None:
+                self.mods[Path(f).stem] = m
+
+    def resolve_callee(self, mod_name: str, call: ast.Call):
+        """-> (module basename, func name) or None. Same-module bare names
+        and `self.helper(...)` attribute calls resolve locally (the lint
+        rule's original semantics); imported names and `sibling.f(...)`
+        resolve across modules."""
+        mod = self.mods[mod_name]
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in mod.funcs:
+                return (mod_name, f.id)
+            origin = mod.aliases.get(f.id)
+            if origin and "." in origin:
+                owner, _, sym = origin.rpartition(".")
+                target = owner.rpartition(".")[2] or owner
+                if target in self.mods and sym in self.mods[target].funcs:
+                    return (target, sym)
+            return None
+        if isinstance(f, ast.Attribute):
+            base = _dotted(f.value)
+            if base is not None:
+                origin = mod.aliases.get(base.partition(".")[0])
+                if origin is not None:
+                    target = origin.rpartition(".")[2] or origin
+                    if target in self.mods and f.attr in self.mods[target].funcs:
+                        return (target, f.attr)
+            if f.attr in mod.funcs:
+                # self.helper(...) / obj.helper(...): same-module method
+                return (mod_name, f.attr)
+        return None
+
+    def jit_roots(self, mod_name: str) -> set:
+        """(module, func) jit roots *declared in* `mod_name`: decorated
+        functions, `name = jax.jit(fn)` wraps of local functions, AND
+        cross-module wraps like `jax.jit(kernel.verify_batch_kernel
+        .__wrapped__)` — the sharded-kernel idiom, where the root function
+        lives one module over from the jit call."""
+        mod = self.mods[mod_name]
+        roots = {(mod_name, r) for r in _jit_roots(mod)}
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _resolve(node.func, mod.aliases) in _JIT_NAMES
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            dotted = _dotted(arg)
+            if dotted is None:
+                continue
+            if dotted.endswith(".__wrapped__"):
+                dotted = dotted[: -len(".__wrapped__")]
+            head, _, rest = dotted.partition(".")
+            if not rest or "." in rest:
+                continue
+            origin = mod.aliases.get(head)
+            if origin is None:
+                continue
+            target = origin.rpartition(".")[2] or origin
+            if target in self.mods and rest in self.mods[target].funcs:
+                roots.add((target, rest))
+        return roots
+
+    def reachable(self, root_mods) -> dict:
+        """BFS from the jit roots declared in `root_mods`: (module, func)
+        -> (root func, module the root was DECLARED in). The declaring
+        module owns the finding — when `verifier.py` jits a kernel that
+        lives in `ed25519.py`, scanning ed25519 alone sees no root."""
+        via: dict = {}
+        queue: list = []
+        for rm in root_mods:
+            for (fmod, r) in sorted(self.jit_roots(rm)):
+                if (fmod, r) not in via:
+                    via[(fmod, r)] = (r, rm)
+                    queue.append((fmod, r))
+        while queue:
+            mod_name, fname = queue.pop()
+            mod = self.mods[mod_name]
+            for node in ast.walk(mod.funcs[fname]):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.resolve_callee(mod_name, node)
+                if callee is not None and callee not in via:
+                    via[callee] = via[(mod_name, fname)]
+                    queue.append(callee)
+        return via
+
+    def allows_at(self, mod: _Mod, line: int) -> set:
+        out: set = set()
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(mod.lines):
+                text = mod.lines[ln - 1]
+                m = _ALLOW_RE.search(text)
+                if m and (ln == line or text.lstrip().startswith("#")):
+                    out.update(
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    )
+        return out
+
+    def impurities(self, root_mods) -> list:
+        out: list[Impurity] = []
+        via = self.reachable(root_mods)
+        for (mod_name, fname), (root, root_mod) in sorted(via.items()):
+            mod = self.mods[mod_name]
+            cross = mod_name != root_mod
+            root_label = (
+                f"jitted `{root}`"
+                if not cross
+                else f"jitted `{root}` ({self.mods[root_mod].rel})"
+            )
+            for line, col, msg in _check_func(mod, fname, root_label):
+                snippet = (
+                    mod.lines[line - 1].strip()
+                    if 1 <= line <= len(mod.lines)
+                    else ""
+                )
+                out.append(
+                    Impurity(
+                        mod.rel, line, col, snippet, msg, fname, root,
+                        self.mods[root_mod].rel, cross,
+                        self.allows_at(mod, line),
+                    )
+                )
+        return out
+
+
+def _check_func(mod: _Mod, fname: str, root_label: str):
+    """The impurity checks, byte-compatible with narwhal-lint's rule."""
+    func = mod.funcs[fname]
+    local_names = {a.arg for a in getattr(func, "args", ast.arguments(args=[])).args}
+    for node in ast.walk(func):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    local_names.add(t.id)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Global):
+            yield (
+                node.lineno,
+                node.col_offset,
+                f"`global {', '.join(node.names)}` inside `{fname}` "
+                f"(reachable from {root_label}): global mutation is "
+                "invisible to the traced kernel after compilation",
+            )
+        elif isinstance(node, ast.Call):
+            target = _resolve(node.func, mod.aliases)
+            if target is None:
+                continue
+            head = target.split(".")[0]
+            if target in _IMPURE_CALLS or (
+                head in _IMPURE_MODULES and head not in local_names
+            ):
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    f"impure call `{target}(...)` in `{fname}` (reachable "
+                    f"from {root_label}): runs once at trace time, then is "
+                    "baked into / elided from the compiled kernel",
+                )
+            elif target.startswith(("numpy.random", "np.random")):
+                yield (
+                    node.lineno,
+                    node.col_offset,
+                    f"`{target}(...)` in `{fname}` (reachable from "
+                    f"{root_label}): host RNG is trace-time constant under "
+                    "jit; thread a jax.random key instead",
+                )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                base = t
+                hops = 0
+                while isinstance(base, (ast.Attribute, ast.Subscript)):
+                    base = base.value
+                    hops += 1
+                if (
+                    hops
+                    and isinstance(base, ast.Name)
+                    and base.id in mod.globals_
+                    and base.id not in local_names
+                ):
+                    yield (
+                        node.lineno,
+                        node.col_offset,
+                        f"mutation of module-level `{base.id}` in `{fname}` "
+                        f"(reachable from {root_label}): happens at trace "
+                        "time only, not per kernel invocation",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def package_purity(files, root: Path) -> list:
+    """All impurities reachable from any jit root in `files` (one
+    directory's sibling modules), cross- and same-module alike."""
+    pkg = _Package(files, Path(root))
+    return pkg.impurities(sorted(pkg.mods))
+
+
+def module_purity(module_path: Path, root: Path) -> list:
+    """Impurities reachable from the jit roots *of this module*, following
+    calls into same-directory sibling modules. Used by the lint rule: it
+    keeps its own same-module reporting and takes the `cross_module`
+    entries from here."""
+    module_path = Path(module_path)
+    files = sorted(
+        p
+        for p in module_path.parent.glob("*.py")
+        if not p.name.endswith("_pb2.py")
+    )
+    if module_path not in files:
+        files.append(module_path)
+    pkg = _Package(files, Path(root))
+    return pkg.impurities([module_path.stem])

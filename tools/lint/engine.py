@@ -39,6 +39,7 @@ from typing import Iterable, Sequence
 # the engine straight at a tripping snippet).
 DEFAULT_EXCLUDES: tuple[str, ...] = (
     "lint_fixtures",  # the analyzer's own tripping/clean test snippets
+    "topo_fixtures",  # narwhal-topo's tripping/clean wiring fixtures
     "__pycache__",
     "*_pb2.py",  # generated protobuf modules
     ".*",
