@@ -146,12 +146,19 @@ async def run_bench(args) -> dict:
 
     from narwhal_tpu.network.rpc import WireStats
 
+    def primary_sent_by_type(a) -> dict[str, float]:
+        m = a.primary.registry.get("wire_bytes_sent_total")
+        if m is None:
+            return {}
+        return {k[0]: c.value for k, c in m._children.items()}
+
     t_start = time.time()
     rounds_start = {
         a.name: a.metric("consensus_last_committed_round")
         for a in cluster.authorities[:alive]
     }
     wire_start = WireStats.snapshot()
+    egress_start = [primary_sent_by_type(a) for a in cluster.authorities[:alive]]
     await asyncio.gather(*(inject(lane) for lane in lanes))
     await asyncio.sleep(args.drain_tail)
     window = time.time() - t_start
@@ -167,6 +174,21 @@ async def run_bench(args) -> dict:
     committed_rounds = max(
         rounds_end[k] - rounds_start.get(k, 0) for k in rounds_end
     )
+    # Per-PRIMARY egress from the per-link wire metrics (the quantity the
+    # fanout tree + delta headers attack), by message type.
+    egress_end = [primary_sent_by_type(a) for a in cluster.authorities[:alive]]
+    egress_delta_by_type: dict[str, float] = {}
+    egress_per_node = []
+    for before, after in zip(egress_start, egress_end):
+        node_total = 0.0
+        for msg_type, value in after.items():
+            d = value - before.get(msg_type, 0.0)
+            node_total += d
+            egress_delta_by_type[msg_type] = (
+                egress_delta_by_type.get(msg_type, 0.0) + d
+            )
+        egress_per_node.append(node_total)
+    mean_egress = sum(egress_per_node) / max(1, len(egress_per_node))
     for d in drains:
         d.cancel()
     client.close()
@@ -209,6 +231,17 @@ async def run_bench(args) -> dict:
         "wire_frames_per_round": (
             round(wire_frames / committed_rounds, 1) if committed_rounds else None
         ),
+        # Per-PRIMARY control-plane egress (mean across nodes) from the
+        # wire_bytes_sent_total{msg_type=} metrics — the r9 wire-diet
+        # acceptance metric — plus the committee-wide breakdown by type.
+        "primary_egress_bytes_per_round": (
+            round(mean_egress / committed_rounds, 1) if committed_rounds else None
+        ),
+        "primary_egress_bytes_by_msg_type": {
+            k: round(v, 1) for k, v in sorted(egress_delta_by_type.items())
+        },
+        "relay_fanout": os.environ.get("NARWHAL_RELAY_FANOUT", "default"),
+        "header_wire": os.environ.get("NARWHAL_HEADER_WIRE", "default"),
         "identical_execution_prefix": (
             (lambda L: all(o[:L] == orders[0][:L] for o in orders))(
                 min(len(o) for o in orders)

@@ -1,0 +1,151 @@
+"""Committee-scale liveness sweep: boot N in-process nodes, sample commit
+progress over time, and account the control-plane wire cost per round.
+
+Extends the hand-rolled run behind `benchmark/results/n50_liveness.json` into
+a repeatable tool (the N=100 gate of ROADMAP item 1):
+
+    python -m benchmark.liveness --nodes 50 --duration 240
+    python -m benchmark.liveness --nodes 100 --duration 300 \
+        --out benchmark/results/n100_liveness.json
+
+No injected load: at these committee sizes on a small host each round is
+thousands of signed+sealed control messages, so the assertion is liveness
+(lockstep commits advancing on every node) and the headline wire metric is
+bytes per committed round — process-wide (WireStats, comparable with the
+pre-wire-diet seed) and per-primary by message type (the new
+wire_bytes_sent_total{msg_type=} counters).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+async def run_liveness(args) -> dict:
+    from narwhal_tpu.cluster import Cluster
+    from narwhal_tpu.config import Parameters
+    from narwhal_tpu.network.rpc import WireStats
+
+    cluster = Cluster(
+        size=args.nodes,
+        workers=args.workers,
+        parameters=Parameters(
+            max_header_delay=args.max_header_delay,
+            max_batch_delay=args.max_batch_delay,
+        ),
+    )
+    t0 = time.time()
+    await cluster.start()
+    boot_s = time.time() - t0
+    print(f"booted {args.nodes} nodes in {boot_s:.0f}s", file=sys.stderr)
+
+    def committed() -> list[float]:
+        return [
+            a.metric("consensus_last_committed_round") for a in cluster.authorities
+        ]
+
+    def primary_sent_by_type() -> dict[str, float]:
+        out: dict[str, float] = {}
+        for a in cluster.authorities:
+            m = a.primary.registry.get("wire_bytes_sent_total")
+            if m is None:
+                continue
+            for k, c in m._children.items():
+                out[k[0]] = out.get(k[0], 0.0) + c.value
+        return out
+
+    samples = []
+    wire0 = WireStats.snapshot()
+    egress0 = primary_sent_by_type()
+    rounds0 = committed()
+    t_start = time.time()
+    try:
+        while time.time() - t_start < args.duration:
+            await asyncio.sleep(args.sample_interval)
+            rounds = committed()
+            samples.append(
+                {
+                    "t_s": round(time.time() - t_start, 1),
+                    "committed_min": min(rounds),
+                    "committed_max": max(rounds),
+                }
+            )
+            print(f"  t={samples[-1]['t_s']}s committed "
+                  f"[{min(rounds)}, {max(rounds)}]", file=sys.stderr)
+    finally:
+        wire1 = WireStats.snapshot()
+        egress1 = primary_sent_by_type()
+        rounds1 = committed()
+        await cluster.shutdown()
+
+    window = time.time() - t_start
+    progressed = max(r1 - r0 for r0, r1 in zip(rounds0, rounds1))
+    min_progress = min(r1 - r0 for r0, r1 in zip(rounds0, rounds1))
+    wire_bytes = wire1["bytes_sent"] - wire0["bytes_sent"]
+    by_type = {
+        k: round(egress1.get(k, 0.0) - egress0.get(k, 0.0), 1)
+        for k in sorted(set(egress0) | set(egress1))
+    }
+    record = {
+        "mode": "in-process liveness",
+        "committee_size": args.nodes,
+        "workers_per_node": args.workers,
+        "parameters": {
+            "max_header_delay_s": args.max_header_delay,
+            "max_batch_delay_s": args.max_batch_delay,
+        },
+        "relay_fanout": os.environ.get("NARWHAL_RELAY_FANOUT", "default"),
+        "header_wire": os.environ.get("NARWHAL_HEADER_WIRE", "default"),
+        "boot_s": round(boot_s, 1),
+        "samples": samples,
+        "committed_rounds_in_window": round(progressed, 1),
+        "committed_rounds_per_s": round(progressed / window, 4),
+        # The liveness gate: every node advanced, and min==max lockstep at
+        # the final sample means nobody was left behind.
+        "all_nodes_progressed": min_progress > 0,
+        "all_nodes_lockstep": min(rounds1) == max(rounds1),
+        "wire_bytes_sent_in_window": wire_bytes,
+        "wire_bytes_per_round": (
+            round(wire_bytes / progressed, 1) if progressed else None
+        ),
+        # Per-primary egress per round (committee aggregate / N / rounds):
+        # the wire-diet acceptance metric, from the per-link counters.
+        "primary_egress_bytes_per_round": (
+            round(sum(by_type.values()) / args.nodes / progressed, 1)
+            if progressed
+            else None
+        ),
+        "primary_egress_bytes_by_msg_type": by_type,
+    }
+    return record
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmark.liveness")
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--duration", type=float, default=240.0)
+    ap.add_argument("--sample-interval", type=float, default=20.0)
+    ap.add_argument("--max-header-delay", type=float, default=1.0)
+    ap.add_argument("--max-batch-delay", type=float, default=0.5)
+    ap.add_argument("--note", default="")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    record = asyncio.run(run_liveness(args))
+    if args.note:
+        record["note"] = args.note
+    print(json.dumps(record, indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

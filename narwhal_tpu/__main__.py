@@ -227,7 +227,11 @@ def main(argv: list[str] | None = None) -> None:
     w.add_argument("--id", type=int, required=True)
 
     b = sub.add_parser("benchmark_client")
-    b.add_argument("--target", required=True)
+    b.add_argument(
+        "--target", required=True, action="append",
+        help="worker transactions address; repeat for a validator's W "
+        "worker lanes (bursts round-robin across them)",
+    )
     b.add_argument("--size", type=int, default=512)
     b.add_argument("--rate", type=int, default=1_000)
     b.add_argument("--nodes", nargs="*", default=[])

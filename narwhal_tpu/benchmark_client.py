@@ -27,12 +27,23 @@ PRECISION = 20  # bursts per second (reference uses 50ms sub-ticks)
 class BenchmarkClient:
     def __init__(
         self,
-        target: str,  # worker transactions address
+        target,  # worker transactions address(es): str or sequence of str
         size: int = 512,
         rate: int = 1_000,
         nodes: tuple[str, ...] = (),
     ):
-        self.target = target
+        # Payload-plane sharding: a validator running W workers exposes W
+        # transaction endpoints; a client given several targets round-robins
+        # its bursts across them (deterministic by burst counter — the
+        # hash-shard analog for anonymous benchmark traffic), so every
+        # worker pipeline carries rate/W and the validator's ingest scales
+        # with W instead of serializing on one lane.
+        self.targets: tuple[str, ...] = (
+            (target,) if isinstance(target, str) else tuple(target)
+        )
+        if not self.targets:
+            raise ValueError("benchmark client needs at least one target")
+        self.target = self.targets[0]  # compat: single-lane callers
         self.size = max(size, 9)
         self.rate = rate
         self.nodes = nodes
@@ -51,7 +62,7 @@ class BenchmarkClient:
         """Wait until every node's tx port accepts connections
         (benchmark_client.rs wait)."""
         deadline = time.monotonic() + timeout
-        for address in (self.target, *self.nodes):
+        for address in (*self.targets, *self.nodes):
             host, port = address.rsplit(":", 1)
             while True:
                 try:
@@ -67,10 +78,10 @@ class BenchmarkClient:
         self._task = asyncio.ensure_future(self.run())
         return self._task
 
-    async def _submit(self, txs: tuple[bytes, ...]) -> None:
+    async def _submit(self, target: str, txs: tuple[bytes, ...]) -> None:
         try:
             await self.network.request(
-                self.target, SubmitTransactionStreamMsg(txs), timeout=5.0
+                target, SubmitTransactionStreamMsg(txs), timeout=5.0
             )
         except (RpcError, OSError) as e:
             logger.warning("Failed to send transaction burst: %s", e)
@@ -101,7 +112,8 @@ class BenchmarkClient:
                 txs.append(tx.ljust(self.size, b"\0"))
             logger.info("Sending sample transaction %d", sample_id)
             # Fire-and-forget: a slow ack must not stall the rate loop.
-            task = asyncio.ensure_future(self._submit(tuple(txs)))
+            target = self.targets[self.counter % len(self.targets)]
+            task = asyncio.ensure_future(self._submit(target, tuple(txs)))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
             self.counter += 1

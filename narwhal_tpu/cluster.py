@@ -53,6 +53,22 @@ class AuthorityDetails:
     def worker_transactions_address(self, worker_id: int = 0) -> str:
         return self.cluster.worker_cache.worker(self.name, worker_id).transactions
 
+    def worker_transactions_addresses(self) -> list[str]:
+        """All W client-facing lanes of this validator, in worker-id order —
+        what a sharding client round-robins across."""
+        return [
+            self.cluster.worker_cache.worker(self.name, wid).transactions
+            for wid in sorted(self.workers)
+        ]
+
+    async def stop_worker(self, worker_id: int) -> None:
+        """Kill ONE worker lane (the worker-loss fault of ROADMAP item 3's
+        scenario axis); the primary and the other W-1 pipelines keep
+        running."""
+        w = self.workers.pop(worker_id, None)
+        if w is not None:
+            await w.shutdown()
+
     async def stop(self) -> None:
         if self.primary is not None:
             await self.primary.shutdown()

@@ -112,6 +112,30 @@ class Parameters:
     # target pins the level at 1.0. 0 disables the latency signals.
     # Env override: NARWHAL_COMMIT_LATENCY_TARGET (seconds).
     commit_latency_target: float = 4.0
+    # -- payload-plane wire diet (primary/fanout.py, primary/delta.py) -----
+    # Fanout-tree dissemination of header/certificate broadcasts: the
+    # origin sends to at most `relay_fanout` children of a deterministic
+    # stake-weighted per-round tree and every receiver forwards to its own
+    # children in the same tree; peers the origin has not heard an ack from
+    # within relay_fallback_timeout get the original message by direct
+    # reliable send, so reliable-broadcast semantics survive crashed
+    # relays. Relaying engages only when the committee is large enough for
+    # the tree to have depth >= 2 (more others than relay_fanout); 0
+    # disables it outright. Env overrides: NARWHAL_RELAY_FANOUT, and
+    # NARWHAL_RELAY=0 as a kill-switch.
+    relay_fanout: int = 3
+    relay_fallback_timeout: float = 0.5
+    # Header/certificate announcement wire form — committee-interoperable
+    # (every node always ACCEPTS both forms; this picks what we SEND):
+    #   full  — self-describing HeaderMsg/CertificateMsg (seed behavior).
+    #   delta — DeltaHeaderMsg (the payload pairs added since the sender's
+    #           last header + 2-byte parent refs into the receiver's
+    #           recent-certificate index) and CertificateDeltaMsg
+    #           (signatures by header reference). Receivers that cannot
+    #           reconstruct fall back to the full-map resync path
+    #           (HeaderResyncRequest keyed off their last-seen round).
+    # Env override: NARWHAL_HEADER_WIRE.
+    header_wire: str = "delta"
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)
@@ -149,6 +173,34 @@ def env_float(name: str, default: float) -> float:
     except ValueError:
         logger.warning("ignoring non-numeric %s=%r (using %s)", name, raw, default)
         return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Environment override for an int knob; non-numeric values are
+    ignored loudly rather than crashing the boot."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r (using %s)", name, raw, default)
+        return default
+
+
+def relay_fanout_effective(parameters: "Parameters") -> int:
+    """The relay fanout after env overrides: NARWHAL_RELAY=0/false/off is
+    the kill-switch (forces direct all-to-all broadcast), NARWHAL_RELAY_FANOUT
+    overrides the branching factor."""
+    if os.environ.get("NARWHAL_RELAY", "1").lower() in ("0", "false", "off"):
+        return 0
+    return max(0, env_int("NARWHAL_RELAY_FANOUT", parameters.relay_fanout))
+
+
+def header_wire_effective(parameters: "Parameters") -> str:
+    """The header/certificate announcement wire form after the
+    NARWHAL_HEADER_WIRE env override (full | delta)."""
+    return os.environ.get("NARWHAL_HEADER_WIRE", parameters.header_wire)
 
 
 @dataclass(frozen=True)

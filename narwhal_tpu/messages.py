@@ -350,6 +350,223 @@ class PayloadAvailabilityResponse:
         return PayloadAvailabilityResponse(tuple(r.seq(dec)))
 
 
+@message(73)
+@dataclass
+class RelayMsg:
+    """Fanout-tree broadcast envelope (primary/fanout.py). The origin sends
+    its header/certificate announcement to its direct children in a
+    deterministic, stake-weighted per-round tree instead of all-to-all;
+    every receiver re-derives the same tree from (epoch, round, origin) and
+    forwards the UNCHANGED envelope to its own children, so the origin's
+    per-round egress is O(fanout) rather than O(N). The inner message rides
+    as raw (tag, body) wire bytes — relays never re-encode, and the ack id
+    every hop agrees on is digest256(tag_le16 || body)."""
+
+    origin: PublicKey  # the broadcasting authority (tree root)
+    round: Round
+    epoch: int
+    inner_tag: int
+    inner_body: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.origin)
+        w.u64(self.round)
+        w.u64(self.epoch)
+        w.u16(self.inner_tag)
+        w.bytes(self.inner_body)
+
+    @staticmethod
+    def decode(r: Reader) -> "RelayMsg":
+        return RelayMsg(
+            r.raw(PUBLIC_KEY_LEN), r.u64(), r.u64(), r.u16(), r.bytes()
+        )
+
+    def inner(self):
+        return decode_message(self.inner_tag, self.inner_body)
+
+    @property
+    def ack_id(self) -> Digest:
+        from .crypto import digest256
+
+        return digest256(self.inner_tag.to_bytes(2, "little") + self.inner_body)
+
+
+@message(74)
+@dataclass
+class RelayAckMsg:
+    """Receipt confirmation a relay RECEIVER sends back to the tree's origin
+    (direct children are covered by the relay RPC ack itself). Peers the
+    origin has not heard from within relay_fallback_timeout get the original
+    message by direct reliable send — the fallback that preserves
+    reliable-broadcast semantics when a relay node crashes. The acker is
+    authenticated by the handshake-verified peer identity; the carried name
+    is only trusted on unauthenticated (bare-test) meshes."""
+
+    ack_id: Digest
+    acker: PublicKey
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.ack_id)
+        w.raw(self.acker)
+
+    @staticmethod
+    def decode(r: Reader) -> "RelayAckMsg":
+        return RelayAckMsg(r.raw(DIGEST_LEN), r.raw(PUBLIC_KEY_LEN))
+
+
+@message(75)
+@dataclass
+class DeltaHeaderMsg:
+    """Header announcement on a wire diet (Parameters.header_wire="delta").
+
+    Carries only the (digest, worker_id) payload pairs added since the
+    sender's last header (in this codebase a header's payload map IS the
+    per-round delta — the proposer clears its digest buffer at every seal),
+    and ref-encodes the O(N) parent set: parents of a round-r header are
+    round r-1 certificates, which every peer already received via the
+    certificate broadcast, so 2 bytes of committee index replace each 32-byte
+    digest. The receiver reconstructs the full Header from its recent
+    certificate index (primary/delta.py), checks the reconstruction against
+    the carried header_digest (collision resistance makes a verified match
+    byte-exact), and runs the normal signature/sanitize path. Any
+    unresolvable parent or digest mismatch triggers the full-map resync path
+    (HeaderResyncRequest, keyed off the receiver's last-seen round)."""
+
+    author: PublicKey
+    round: Round
+    epoch: int
+    header_digest: Digest
+    payload: tuple[tuple[Digest, WorkerId], ...]  # pairs added since last header
+    parent_indices: tuple[int, ...]  # committee dense indices of parent origins
+    signature: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.author)
+        w.u64(self.round)
+        w.u64(self.epoch)
+        w.raw(self.header_digest)
+
+        def enc_pair(w_: Writer, item) -> None:
+            w_.raw(item[0])
+            w_.u32(item[1])
+
+        w.seq(self.payload, enc_pair)
+        w.seq(self.parent_indices, lambda w_, i: w_.u16(i))
+        w.bytes(self.signature)
+
+    @staticmethod
+    def decode(r: Reader) -> "DeltaHeaderMsg":
+        return DeltaHeaderMsg(
+            r.raw(PUBLIC_KEY_LEN),
+            r.u64(),
+            r.u64(),
+            r.raw(DIGEST_LEN),
+            tuple(r.seq(lambda r_: (r_.raw(DIGEST_LEN), r_.u32()))),
+            tuple(r.seq(lambda r_: r_.u16())),
+            r.bytes(),
+        )
+
+
+@message(76)
+@dataclass
+class HeaderResyncRequest:
+    """Full-map resync for a delta header the receiver could not
+    reconstruct: ask the AUTHOR for the full header by digest, keyed off the
+    receiver's last-seen round for that author so the response can also
+    carry the author's intervening headers (the receiver is behind by more
+    than one round exactly when parents stop resolving)."""
+
+    header_digest: Digest
+    author: PublicKey
+    since_round: Round  # receiver's last-seen round for this author
+    requestor: PublicKey = b"\0" * 32
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.header_digest)
+        w.raw(self.author)
+        w.u64(self.since_round)
+        w.raw(self.requestor)
+
+    @staticmethod
+    def decode(r: Reader) -> "HeaderResyncRequest":
+        return HeaderResyncRequest(
+            r.raw(DIGEST_LEN), r.raw(PUBLIC_KEY_LEN), r.u64(), r.raw(PUBLIC_KEY_LEN)
+        )
+
+
+@message(77)
+@dataclass
+class HeaderResyncResponse:
+    """Full headers answering a HeaderResyncRequest: the requested header
+    plus any of the author's own headers after since_round it still holds
+    (bounded). Receivers feed every entry through the normal sanitize path —
+    a byzantine responder can only send headers that fail verification."""
+
+    headers: tuple[Header, ...]
+
+    def encode(self, w: Writer) -> None:
+        w.seq(self.headers, lambda w_, h: h.encode(w_))
+
+    @staticmethod
+    def decode(r: Reader) -> "HeaderResyncResponse":
+        return HeaderResyncResponse(tuple(r.seq(Header.decode)))
+
+
+@message(78)
+@dataclass
+class CertificateDeltaMsg:
+    """Full-format certificate broadcast WITHOUT the embedded header body
+    (the header_wire="delta" analog of CertificateRefMsg): every peer that
+    voted already stores the header — a round's header bytes otherwise
+    travel every link twice (HeaderMsg, then again inside CertificateMsg).
+    Receivers rebuild the Certificate from their header store and fall back
+    to fetching the full certificate from the origin on miss (same
+    resolution path as CertificateRefMsg)."""
+
+    header_digest: Digest
+    round: Round
+    epoch: int
+    origin: PublicKey
+    signers: tuple[int, ...]
+    signatures: tuple[bytes, ...]  # 64-byte ed25519 signatures
+
+    @staticmethod
+    def from_certificate(cert: Certificate) -> "CertificateDeltaMsg":
+        assert not cert.is_compact
+        return CertificateDeltaMsg(
+            cert.header.digest,
+            cert.round,
+            cert.epoch,
+            cert.origin,
+            cert.signers,
+            cert.signatures,
+        )
+
+    def rebuild(self, header: Header) -> Certificate:
+        return Certificate(header, self.signers, self.signatures)
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.header_digest)
+        w.u64(self.round)
+        w.u64(self.epoch)
+        w.raw(self.origin)
+        # u16 committee indices: dense ids, and this message exists to
+        # shave broadcast bytes.
+        w.seq(self.signers, lambda w_, i: w_.u16(i))
+        w.seq(self.signatures, lambda w_, s: w_.raw(s))
+
+    @staticmethod
+    def decode(r: Reader) -> "CertificateDeltaMsg":
+        return CertificateDeltaMsg(
+            r.raw(DIGEST_LEN),
+            r.u64(),
+            r.u64(),
+            r.raw(PUBLIC_KEY_LEN),
+            tuple(r.seq(lambda r_: r_.u16())),
+            tuple(r.seq(lambda r_: r_.raw(64))),
+        )
+
+
 # ---------------------------------------------------------------------------
 # Primary -> Worker (types/src/primary.rs:702-750)
 # ---------------------------------------------------------------------------

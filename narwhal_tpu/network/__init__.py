@@ -6,6 +6,7 @@ from .rpc import (
     RpcError,
     RpcServer,
     RpcTimeout,
+    WireCounters,
 )
 
 __all__ = [
@@ -18,6 +19,7 @@ __all__ = [
     "RpcError",
     "RpcServer",
     "RpcTimeout",
+    "WireCounters",
     "cached_allow_sets",
     "committee_resolver",
 ]

@@ -135,6 +135,85 @@ class WireStats:
         }
 
 
+class WireCounters:
+    """Per-ROLE wire accounting (one instance per primary/worker network,
+    unlike the process-wide WireStats): every frame the role writes or
+    reads, bucketed by message type, surfaced as the registry counters
+    `wire_bytes_{sent,received}_total{msg_type=}` and
+    `wire_frames_{sent,received}_total{msg_type=}`. Plain integer totals
+    (`bytes_sent`/`bytes_received`) ride along for cheap deltas — the
+    core's per-round egress gauge reads them once per round. Cost per frame
+    is two int adds + one cached labels() lookup."""
+
+    __slots__ = (
+        "bytes_sent",
+        "bytes_received",
+        "frames_sent",
+        "frames_received",
+        "_sent_bytes_m",
+        "_recv_bytes_m",
+        "_sent_frames_m",
+        "_recv_frames_m",
+        "_label_cache",
+    )
+
+    def __init__(self, registry=None):
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.frames_sent = 0
+        self.frames_received = 0
+        self._sent_bytes_m = self._recv_bytes_m = None
+        self._sent_frames_m = self._recv_frames_m = None
+        self._label_cache: dict[int, str] = {}
+        if registry is not None:
+            self._sent_bytes_m = registry.counter(
+                "wire_bytes_sent_total",
+                "Wire bytes written by this role, by message type",
+                labels=("msg_type",),
+            )
+            self._recv_bytes_m = registry.counter(
+                "wire_bytes_received_total",
+                "Wire bytes read by this role, by message type",
+                labels=("msg_type",),
+            )
+            self._sent_frames_m = registry.counter(
+                "wire_frames_sent_total",
+                "Frames written by this role, by message type",
+                labels=("msg_type",),
+            )
+            self._recv_frames_m = registry.counter(
+                "wire_frames_received_total",
+                "Frames read by this role, by message type",
+                labels=("msg_type",),
+            )
+
+    def _type_name(self, tag: int) -> str:
+        name = self._label_cache.get(tag)
+        if name is None:
+            from ..messages import REGISTRY
+
+            cls = REGISTRY.get(tag)
+            name = cls.__name__ if cls is not None else f"tag{tag}"
+            self._label_cache[tag] = name
+        return name
+
+    def record_sent(self, tag: int, wire_len: int) -> None:
+        self.bytes_sent += wire_len
+        self.frames_sent += 1
+        if self._sent_bytes_m is not None:
+            name = self._type_name(tag)
+            self._sent_bytes_m.labels(name).inc(wire_len)
+            self._sent_frames_m.labels(name).inc()
+
+    def record_received(self, tag: int, wire_len: int) -> None:
+        self.bytes_received += wire_len
+        self.frames_received += 1
+        if self._recv_bytes_m is not None:
+            name = self._type_name(tag)
+            self._recv_bytes_m.labels(name).inc(wire_len)
+            self._recv_frames_m.labels(name).inc()
+
+
 def _write_frame(
     writer: asyncio.StreamWriter,
     kind: int,
@@ -142,6 +221,7 @@ def _write_frame(
     tag: int,
     body: bytes,
     session: Session | None = None,
+    counters: WireCounters | None = None,
 ) -> None:
     # Two writes instead of one concatenated buffer: batch frames are large
     # (hundreds of KB) and the header+body copy showed up at high rates.
@@ -160,10 +240,14 @@ def _write_frame(
         wire_len = _FRAME_HDR.size + len(body)
     WireStats.frames_sent += 1
     WireStats.bytes_sent += wire_len
+    if counters is not None:
+        counters.record_sent(tag, wire_len)
 
 
 async def _read_frame(
-    reader: asyncio.StreamReader, session: Session | None = None
+    reader: asyncio.StreamReader,
+    session: Session | None = None,
+    counters: WireCounters | None = None,
 ) -> tuple[int, int, int, bytes]:
     hdr = await reader.readexactly(_FRAME_HDR.size)
     length, kind, rid, tag = _FRAME_HDR.unpack(hdr)
@@ -172,6 +256,8 @@ async def _read_frame(
     body = await reader.readexactly(length) if length else b""
     WireStats.frames_received += 1
     WireStats.bytes_received += _FRAME_HDR.size + length
+    if counters is not None:
+        counters.record_received(tag, _FRAME_HDR.size + length)
     if session is not None:
         if length < MAC_LEN:
             raise RpcError("unauthenticated frame on authenticated connection")
@@ -198,17 +284,27 @@ class FrameSender:
     their own timeouts/retry handles, server responses by the per-
     connection dispatch semaphore (MAX_TASK_CONCURRENCY)."""
 
-    __slots__ = ("_writer", "_session", "_on_error", "_queue", "_task", "_closed")
+    __slots__ = (
+        "_writer",
+        "_session",
+        "_on_error",
+        "_queue",
+        "_task",
+        "_closed",
+        "_counters",
+    )
 
     def __init__(
         self,
         writer: asyncio.StreamWriter,
         session: Session | None = None,
         on_error: Callable[[Exception], None] | None = None,
+        counters: WireCounters | None = None,
     ):
         self._writer = writer
         self._session = session
         self._on_error = on_error
+        self._counters = counters
         self._queue: list[tuple[int, int, int, bytes]] = []
         self._task: asyncio.Task | None = None
         self._closed = False
@@ -228,7 +324,8 @@ class FrameSender:
                 batch, self._queue = self._queue, []
                 for kind, rid, tag, body in batch:
                     _write_frame(
-                        self._writer, kind, rid, tag, body, self._session
+                        self._writer, kind, rid, tag, body, self._session,
+                        self._counters,
                     )
                 WireStats.record_drain(len(batch))
                 # Frames enqueued while this drain awaits ride the next
@@ -256,9 +353,11 @@ class PeerClient:
         self,
         address: str,
         credentials: Credentials | None = None,
+        counters: WireCounters | None = None,
     ):
         self.address = address
         self._credentials = credentials
+        self._counters = counters
         self._writer: asyncio.StreamWriter | None = None
         self._sender: FrameSender | None = None
         self._reader_task: asyncio.Task | None = None
@@ -302,6 +401,7 @@ class PeerClient:
                 on_error=lambda e: self._teardown(
                     RpcError(f"send to {self.address} failed: {e}")
                 ),
+                counters=self._counters,
             )
             self._reader_task = asyncio.ensure_future(self._read_loop(reader, session))
 
@@ -310,7 +410,9 @@ class PeerClient:
     ) -> None:
         try:
             while True:
-                kind, rid, tag, body = await _read_frame(reader, session)
+                kind, rid, tag, body = await _read_frame(
+                    reader, session, self._counters
+                )
                 if kind == KIND_HELLO and session is None:
                     # The server demands a handshake we are not configured
                     # for: fail every pending request immediately instead of
@@ -429,12 +531,14 @@ class RpcServer:
         self,
         max_concurrency: int = MAX_TASK_CONCURRENCY,
         auth_keypair=None,
+        counters: WireCounters | None = None,
     ):
         self._handlers: dict[int, tuple[Handler, Callable[[Peer], bool] | None]] = {}
         self._server: asyncio.AbstractServer | None = None
         self._max_concurrency = max_concurrency
         self._writers: set[asyncio.StreamWriter] = set()
         self._auth_keypair = auth_keypair
+        self._counters = counters
 
     def route(self, msg_cls, handler: Handler, allow=None) -> None:
         # Deny-by-default on authenticated servers: the handshake only proves
@@ -520,9 +624,11 @@ class RpcServer:
                     return
             # Responses coalesce per connection: concurrent handlers that
             # complete in the same window share one socket flush.
-            sender = FrameSender(writer, session)
+            sender = FrameSender(writer, session, counters=self._counters)
             while True:
-                kind, rid, tag, body = await _read_frame(reader, session)
+                kind, rid, tag, body = await _read_frame(
+                    reader, session, self._counters
+                )
                 if kind != KIND_REQ:
                     continue
                 await sem.acquire()
@@ -613,16 +719,18 @@ class NetworkClient:
         self,
         retry: RetryConfig | None = None,
         credentials: Credentials | None = None,
+        counters: WireCounters | None = None,
     ):
         self._peers: dict[str, PeerClient] = {}
         self._retry = retry or RetryConfig(max_elapsed=None)
         self._send_tasks: set[asyncio.Task] = set()
         self._credentials = credentials
+        self._counters = counters
 
     def peer(self, address: str) -> PeerClient:
         client = self._peers.get(address)
         if client is None:
-            client = PeerClient(address, self._credentials)
+            client = PeerClient(address, self._credentials, self._counters)
             self._peers[address] = client
         return client
 

@@ -142,6 +142,14 @@ class PrimaryNode:
             raise ValueError(
                 f"parameters.cert_format must be full|compact, got {cert_format!r}"
             )
+        # header_wire only selects what WE send (every node accepts both
+        # forms), but a typo silently behaving as "full" would quietly
+        # forfeit the wire diet — fail fast like cert_format.
+        header_wire = getattr(parameters, "header_wire", "full")
+        if header_wire not in ("full", "delta"):
+            raise ValueError(
+                f"parameters.header_wire must be full|delta, got {header_wire!r}"
+            )
         if rule == "cofactored" and crypto_backend != "tpu":
             raise ValueError(
                 "parameters.verify_rule=cofactored: only the tpu crypto "

@@ -30,6 +30,11 @@ from ..types import (
     Vote,
 )
 from .aggregators import CertificatesAggregator, VotesAggregator
+from .delta import (
+    HeaderDeltaCodec,
+    encode_announcement,
+    encode_certificate_announcement,
+)
 from .synchronizer import Synchronizer
 from .verifier_stage import PreVerified
 
@@ -59,6 +64,9 @@ class Core:
         rx_reconfigure: Watch,
         metrics=None,
         cert_format: str = "full",  # full | compact (Parameters.cert_format)
+        fanout=None,  # fanout.FanoutBroadcaster: tree dissemination
+        header_wire: str = "full",  # full | delta (Parameters.header_wire)
+        wire_counters=None,  # network.WireCounters: per-round egress gauge
     ):
         self.name = name
         self.committee = committee
@@ -84,6 +92,17 @@ class Core:
         self.highest_received_round: Round = 0
         self.current_header: Header | None = None
         self.cert_format = cert_format
+        # Wire diet: fanout-tree dissemination + delta-encoded
+        # header/certificate announcements (primary/fanout.py, delta.py).
+        # The codec always runs (decoding must work whatever WE send);
+        # header_wire only selects the form we broadcast.
+        self.fanout = fanout
+        self.header_wire = header_wire
+        self.delta_codec = HeaderDeltaCodec(committee)
+        self.wire_counters = wire_counters
+        self._egress_at_last_header = (
+            wire_counters.bytes_sent if wire_counters is not None else 0
+        )
         self.votes_aggregator = VotesAggregator(cert_format)
         self.certificates_aggregators: dict[Round, CertificatesAggregator] = {}
         self.processing: dict[Round, set[Digest]] = {}
@@ -125,18 +144,41 @@ class Core:
     async def process_own_header(self, header: Header) -> None:
         self.current_header = header
         self.votes_aggregator = VotesAggregator(self.cert_format)
-        from ..messages import HeaderMsg
-
-        addresses = [addr for _, addr, _ in self.committee.others_primaries(self.name)]
-        handlers = self.network.broadcast(addresses, HeaderMsg(header))
-        self.cancel_handlers.setdefault(header.round, []).extend(handlers)
+        if self.wire_counters is not None and self.metrics is not None:
+            # Per-round egress: everything this primary wrote to the wire
+            # since its previous header (the quantity the fanout tree +
+            # delta encodings exist to shrink; MB/round from metrics, not
+            # log scraping).
+            total = self.wire_counters.bytes_sent
+            self.metrics.round_egress_bytes.set(total - self._egress_at_last_header)
+            self._egress_at_last_header = total
+        self.delta_codec.note_own_header(header)
+        msg = encode_announcement(self.delta_codec, header, self.header_wire)
+        self._broadcast(header.round, msg)
         await self.process_header(header)
+
+    def _broadcast(self, round: Round, msg) -> None:
+        """Disseminate an announcement: through the fanout tree when one is
+        wired (it owns + GCs the handles), else the reference's all-to-all
+        reliable broadcast with round-keyed cancel handles."""
+        if self.fanout is not None:
+            self.fanout.broadcast(round, msg)
+            return
+        addresses = [
+            addr for _, addr, _ in self.committee.others_primaries(self.name)
+        ]
+        handlers = self.network.broadcast(addresses, msg)
+        self.cancel_handlers.setdefault(round, []).extend(handlers)
 
     # ------------------------------------------------------------------
     # Header path (core.rs:183-355)
     # ------------------------------------------------------------------
     async def process_header(self, header: Header) -> None:
         self.processing.setdefault(header.round, set()).add(header.digest)
+        # Headers reach us a full round before their certificates: index
+        # the DERIVED certificate digest now so peers' next-round delta
+        # headers reconstruct without waiting on the certificate broadcast.
+        self.delta_codec.note_header(header)
         if header.payload and self.on_payload_header is not None:
             self.on_payload_header()
 
@@ -232,21 +274,11 @@ class Core:
                 # Stage tracing: the proposer started this clock when it
                 # proposed the header this certificate certifies.
                 self.metrics.certify_timer.stop(certificate.header.digest)
-            from ..messages import CertificateMsg, CertificateRefMsg
-
-            addresses = [
-                addr for _, addr, _ in self.committee.others_primaries(self.name)
-            ]
-            # Compact certificates broadcast by reference: peers hold the
-            # header already (they voted on it), so the announcement omits
-            # the header body (messages.CertificateRefMsg).
-            msg = (
-                CertificateRefMsg.from_certificate(certificate)
-                if certificate.is_compact
-                else CertificateMsg(certificate)
-            )
-            handlers = self.network.broadcast(addresses, msg)
-            self.cancel_handlers.setdefault(certificate.round, []).extend(handlers)
+            # Compact certificates broadcast by reference (peers hold the
+            # header already — they voted on it); full-format ones shed the
+            # embedded header body the same way under header_wire="delta".
+            msg = encode_certificate_announcement(certificate, self.header_wire)
+            self._broadcast(certificate.round, msg)
             await self.process_certificate(certificate)
 
     # ------------------------------------------------------------------
@@ -279,6 +311,10 @@ class Core:
         self._pending_commits.append(
             self.certificate_store.write_async(certificate)
         )
+        # Accepted certificates feed the delta codec's recent index: the
+        # encoder resolves its own parents from here, the decoder any delta
+        # header the core drains after this certificate.
+        self.delta_codec.note_certificate(certificate)
         if self.metrics is not None:
             self.metrics.certificates_processed.inc()
 
@@ -395,6 +431,9 @@ class Core:
         for r in [r for r in self.cancel_handlers if r <= gc_round]:
             for handler in self.cancel_handlers.pop(r):
                 handler.cancel()
+        self.delta_codec.gc(gc_round)
+        if self.fanout is not None:
+            self.fanout.gc(gc_round)
         if self.metrics is not None:
             self.metrics.gc_round.set(gc_round)
 
@@ -504,4 +543,7 @@ class Core:
             for handler in handlers:
                 handler.cancel()
         self.cancel_handlers.clear()
+        self.delta_codec.change_epoch(committee)
+        if self.fanout is not None:
+            self.fanout.change_epoch(committee)
         self.synchronizer.update_genesis(self.committee)

@@ -119,7 +119,16 @@ class HeaderWaiter:
         gathered = asyncio.gather(*waiters)
         try:
             while True:
-                await self._sync_batches_once(msg.missing, header.author)
+                # Trim per tick: batches that arrived since the last tick
+                # must not ride the next Synchronize — the worker would
+                # re-fetch (and peers re-ship) payload we already hold.
+                still_missing = {
+                    digest: worker_id
+                    for digest, worker_id in msg.missing.items()
+                    if not self.payload_store.contains(digest, worker_id)
+                }
+                if still_missing:
+                    await self._sync_batches_once(still_missing, header.author)
                 try:
                     await asyncio.wait_for(
                         asyncio.shield(gathered), self.parameters.sync_retry_delay

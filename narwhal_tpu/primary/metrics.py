@@ -79,3 +79,38 @@ class PrimaryMetrics:
             "bounded burst; >1 means one grouped commit served several)",
             buckets=(1, 2, 4, 8, 16, 32, 64),
         )
+        # -- payload-plane wire diet (fanout tree + delta headers) ---------
+        self.round_egress_bytes = registry.gauge(
+            "primary_round_egress_bytes",
+            "Bytes this primary wrote to the wire between its two most "
+            "recent own headers (MB/round from metrics, not log scraping)",
+        )
+        self.relay_broadcasts = registry.counter(
+            "primary_relay_broadcasts",
+            "Own announcements disseminated through the fanout tree "
+            "instead of all-to-all",
+        )
+        self.relays_forwarded = registry.counter(
+            "primary_relays_forwarded",
+            "Relay envelopes forwarded to our children in a peer's tree",
+        )
+        self.relay_acks_received = registry.counter(
+            "primary_relay_acks_received",
+            "Receipt confirmations for our own fanout broadcasts",
+        )
+        self.relay_fallback_sends = registry.counter(
+            "primary_relay_fallback_sends",
+            "Direct reliable sends to peers un-acked past "
+            "relay_fallback_timeout (the crashed-relay recovery path)",
+        )
+        self.delta_headers_rebuilt = registry.counter(
+            "primary_delta_headers_rebuilt",
+            "Delta header announcements reconstructed from the local "
+            "recent-certificate index (no resync round trip)",
+        )
+        self.delta_resyncs = registry.counter(
+            "primary_delta_resyncs",
+            "Full-map resync requests sent because a delta header would "
+            "not reconstruct (missing parent certificate or digest "
+            "mismatch)",
+        )

@@ -15,25 +15,40 @@ import asyncio
 import logging
 
 from ..channels import Channel, Watch, drain_cancelled, metered_channel
-from ..config import Committee, Parameters, WorkerCache, env_float, pacing_enabled
+from ..config import (
+    Committee,
+    Parameters,
+    WorkerCache,
+    env_float,
+    header_wire_effective,
+    pacing_enabled,
+    relay_fanout_effective,
+)
 from ..crypto import SignatureService
 from ..messages import (
+    CertificateDeltaMsg,
     CertificatesBatchRequest,
     CertificatesRangeRequest,
     CertificateMsg,
+    DeltaHeaderMsg,
     HeaderMsg,
+    HeaderResyncRequest,
+    HeaderResyncResponse,
     OthersBatchMsg,
     OurBatchMsg,
     PayloadAvailabilityRequest,
     ReconfigureMsg,
+    RelayAckMsg,
+    RelayMsg,
     VoteMsg,
 )
 from ..metrics import Registry
-from ..network import NetworkClient, RpcServer, cached_allow_sets
+from ..network import NetworkClient, RpcServer, WireCounters, cached_allow_sets
 from ..stores import NodeStorage
 from ..types import Certificate, PublicKey, ReconfigureNotification
 from .certificate_waiter import CertificateWaiter
 from .core import Core
+from .fanout import FanoutBroadcaster
 from .header_waiter import HeaderWaiter
 from .helper import Helper
 from .metrics import PrimaryMetrics
@@ -81,9 +96,17 @@ class Primary:
                 network_keypair,
                 committee_resolver(lambda: self.committee, lambda: self.worker_cache),
             )
-        self.network = NetworkClient(credentials=credentials)
+        # Per-link wire accounting: every frame this primary writes/reads,
+        # by message type (wire_bytes_{sent,received}_total{msg_type=}) —
+        # the measurement plane for the fanout/delta wire diet.
+        self.wire_counters = WireCounters(self.registry)
+        self.network = NetworkClient(
+            credentials=credentials, counters=self.wire_counters
+        )
         self.server = RpcServer(
-            parameters.max_concurrent_requests, auth_keypair=network_keypair
+            parameters.max_concurrent_requests,
+            auth_keypair=network_keypair,
+            counters=self.wire_counters,
         )
         self._tasks: list[asyncio.Task] = []
 
@@ -125,6 +148,17 @@ class Primary:
         self.helper = Helper(
             committee, storage.certificate_store, storage.payload_store
         )
+        # Fanout-tree dissemination (degenerates to direct broadcast when
+        # the committee is too small for the tree to have depth >= 2, and
+        # under the NARWHAL_RELAY=0 kill-switch).
+        self.fanout = FanoutBroadcaster(
+            name,
+            committee,
+            self.network,
+            fanout=relay_fanout_effective(parameters),
+            fallback_timeout=parameters.relay_fallback_timeout,
+            metrics=self.metrics,
+        )
         self.core = Core(
             name,
             committee,
@@ -146,6 +180,9 @@ class Primary:
             self.tx_reconfigure,
             self.metrics,
             cert_format=getattr(parameters, "cert_format", "full"),
+            fanout=self.fanout,
+            header_wire=header_wire_effective(parameters),
+            wire_counters=self.wire_counters,
         )
         self.core.tx_certificate_waiter = self.tx_sync_certificates
         # Adaptive header pacing: the proposer's effective delay tracks the
@@ -261,6 +298,20 @@ class Primary:
         self.server.route(
             CertificateRefMsg, self._on_certificate_ref, allow=allow_peer_primary
         )
+        # Wire-diet plane: relay envelopes + delta announcements + resync.
+        self.server.route(RelayMsg, self._on_relay, allow=allow_peer_primary)
+        self.server.route(RelayAckMsg, self._on_relay_ack, allow=allow_peer_primary)
+        self.server.route(
+            DeltaHeaderMsg, self._on_delta_header, allow=allow_peer_primary
+        )
+        # CertificateDeltaMsg shares CertificateRefMsg's resolution path:
+        # identical field names + rebuild(header) signature.
+        self.server.route(
+            CertificateDeltaMsg, self._on_certificate_ref, allow=allow_peer_primary
+        )
+        self.server.route(
+            HeaderResyncRequest, self._on_header_resync, allow=allow_peer_primary
+        )
         self.server.route(
             CertificatesBatchRequest,
             self.helper.on_certificates_batch,
@@ -337,6 +388,94 @@ class Primary:
         await self._ingest(msg.certificate)
         return None
 
+    async def _on_relay(self, msg: RelayMsg, peer: str):
+        """Fanout-tree envelope: forward to our children in the origin's
+        tree + ack the origin (both non-blocking), then deliver the inner
+        announcement through the same ingest path a direct send takes."""
+        try:
+            inner = msg.inner()
+        except ValueError as e:
+            logger.warning("relay with undecodable inner message: %s", e)
+            return None
+        self.fanout.on_relay(msg)
+        await self._deliver_announcement(inner, peer)
+        return None
+
+    async def _deliver_announcement(self, inner, peer) -> None:
+        if isinstance(inner, HeaderMsg):
+            await self._ingest(inner.header)
+        elif isinstance(inner, DeltaHeaderMsg):
+            await self._on_delta_header(inner, peer)
+        elif isinstance(inner, CertificateMsg):
+            await self._ingest(inner.certificate)
+        elif hasattr(inner, "rebuild"):  # CertificateDeltaMsg | CertificateRefMsg
+            await self._on_certificate_ref(inner, peer)
+        else:
+            logger.warning("relay carried unexpected %r", type(inner))
+
+    async def _on_relay_ack(self, msg: RelayAckMsg, peer):
+        self.fanout.on_ack(msg, getattr(peer, "key", None))
+        return None
+
+    async def _on_delta_header(self, msg: DeltaHeaderMsg, peer: str):
+        """Delta header announcement: reconstruct from the recent-certificate
+        index (self-verifying against the carried digest), else retry once
+        shortly — the missing parent certificate is usually in flight on
+        another link — and finally resync the full header from the author."""
+        header = self.core.delta_codec.decode_header(msg)
+        if header is not None:
+            self.metrics.delta_headers_rebuilt.inc()
+            await self._ingest(header)
+            return None
+        task = asyncio.ensure_future(self._resync_header(msg))
+        self._ref_tasks.add(task)
+        task.add_done_callback(self._ref_tasks.discard)
+        return None
+
+    async def _resync_header(self, msg: DeltaHeaderMsg) -> None:
+        # Grace for in-flight parent certificates: the core drains its
+        # queue in arrival order, so one short beat usually resolves the
+        # reconstruction without paying the resync round trip.
+        await asyncio.sleep(0.15)
+        header = self.core.delta_codec.decode_header(msg)
+        if header is not None:
+            self.metrics.delta_headers_rebuilt.inc()
+            await self._ingest(header)
+            return
+        self.metrics.delta_resyncs.inc()
+        try:
+            address = self.committee.primary_address(msg.author)
+            resp: HeaderResyncResponse = await self.network.request(
+                address,
+                HeaderResyncRequest(
+                    msg.header_digest,
+                    msg.author,
+                    self.core.delta_codec.last_seen_round(msg.author),
+                    self.name,
+                ),
+                timeout=5.0,
+            )
+        except Exception as e:
+            logger.debug("header resync from author failed: %s", e)
+            return
+        for header in getattr(resp, "headers", ()) or ():
+            # Full sanitize path: a byzantine responder can only send
+            # headers that fail verification.
+            await self._ingest(header)
+
+    async def _on_header_resync(self, msg: HeaderResyncRequest, peer: str):
+        headers = []
+        wanted = self.header_store.read(msg.header_digest)
+        if wanted is not None:
+            headers.append(wanted)
+        if msg.author == self.name:
+            headers.extend(
+                self.core.delta_codec.own_headers_since(
+                    msg.since_round, exclude=msg.header_digest
+                )
+            )
+        return HeaderResyncResponse(tuple(headers))
+
     async def _on_certificate_ref(self, msg, peer: str):
         """Compact-certificate announcement: rebuild from our header store
         (we voted on the header, so the common case is a local hit), or
@@ -411,6 +550,7 @@ class Primary:
     # -- lifecycle ---------------------------------------------------------
     async def shutdown(self) -> None:
         self.tx_reconfigure.send(ReconfigureNotification("shutdown"))
+        self.fanout.shutdown()
         if self.verifier_stage is not None:
             self.verifier_stage.shutdown()
         for t in list(self._ref_tasks):

@@ -116,6 +116,15 @@ class WorkerSynchronizer:
     async def _fetch(self, address: str, digests: tuple[Digest, ...]) -> None:
         """One fetch attempt; received batches flow through the others-batch
         processor path, which stores them and notifies the primary."""
+        # Trim at send time, not just at spawn time: between the retry tick
+        # that built this want-list and this task actually running, digests
+        # may have arrived (another fetch's response, a peer's broadcast).
+        # Re-requesting them re-ships whole batches for nothing.
+        digests = tuple(
+            d for d in digests if d in self.pending and not self.store.contains(d)
+        )
+        if not digests:
+            return
         try:
             resp: WorkerBatchResponse = await self.network.request(
                 address, WorkerBatchRequest(digests), timeout=5.0

@@ -40,7 +40,7 @@ from ..messages import (
     WorkerBatchResponse,
 )
 from ..metrics import Registry
-from ..network import NetworkClient, RpcServer, cached_allow_sets
+from ..network import NetworkClient, RpcServer, WireCounters, cached_allow_sets
 from ..pacing import BackpressureState, IngestGate, PacingController
 from ..stores import BatchStore
 from ..types import (
@@ -96,11 +96,20 @@ class Worker:
                 network_keypair,
                 committee_resolver(lambda: self.committee, lambda: self.worker_cache),
             )
-        self.network = NetworkClient(credentials=credentials)
-        self.server = RpcServer(
-            parameters.max_concurrent_requests, auth_keypair=network_keypair
+        # Per-link wire accounting for the payload plane (batch
+        # dissemination is the data-plane bulk of MB/round).
+        self.wire_counters = WireCounters(self.registry)
+        self.network = NetworkClient(
+            credentials=credentials, counters=self.wire_counters
         )
-        self.tx_server = RpcServer(parameters.max_concurrent_requests)
+        self.server = RpcServer(
+            parameters.max_concurrent_requests,
+            auth_keypair=network_keypair,
+            counters=self.wire_counters,
+        )
+        self.tx_server = RpcServer(
+            parameters.max_concurrent_requests, counters=self.wire_counters
+        )
         self.rx_reconfigure: Watch = Watch(ReconfigureNotification("boot"))
         self._tasks: list[asyncio.Task] = []
 

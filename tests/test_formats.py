@@ -113,6 +113,14 @@ def _golden_messages():
         M.GetPrimaryAddressRequest: M.GetPrimaryAddressRequest(),
         M.GetPrimaryAddressResponse: M.GetPrimaryAddressResponse("h:1"),
         M.NewEpochRequest: M.NewEpochRequest(1),
+        M.RelayMsg: M.RelayMsg(pk, 3, 0, M.HeaderMsg.TAG, b"\x44" * 16),
+        M.RelayAckMsg: M.RelayAckMsg(d1, pk),
+        M.DeltaHeaderMsg: M.DeltaHeaderMsg(
+            pk, 2, 0, d1, ((d2, 1),), (0, 2, 3), b"\x55" * 64
+        ),
+        M.HeaderResyncRequest: M.HeaderResyncRequest(d1, pk, 1, pk),
+        M.HeaderResyncResponse: M.HeaderResyncResponse((header,)),
+        M.CertificateDeltaMsg: M.CertificateDeltaMsg.from_certificate(cert),
     }
 
 

@@ -339,8 +339,8 @@ def test_rpc_coalescing_equivalence_concurrent_vs_sequential(run):
         received: list[tuple[int, int, bytes]] = []
         orig_read = rpc_mod._read_frame
 
-        async def spy_read(reader, session=None):
-            kind, rid, tag, body = await orig_read(reader, session)
+        async def spy_read(reader, session=None, counters=None):
+            kind, rid, tag, body = await orig_read(reader, session, counters)
             received.append((kind, tag, bytes(body)))
             return kind, rid, tag, body
 

@@ -215,3 +215,61 @@ def test_worker_synchronize_retry_via_lucky_broadcast(run):
         client.close()
 
     run(scenario())
+
+
+def test_synchronizer_never_rerequests_satisfied_digests(run):
+    """Retry ticks must trim the want-list: a digest that arrived (via a
+    fetch response or a peer broadcast) is never re-requested — before the
+    trim, every lucky-broadcast tick re-shipped the WHOLE original
+    want-list to sync_retry_nodes peers."""
+
+    async def scenario():
+        from narwhal_tpu.worker.synchronizer import WorkerSynchronizer
+
+        f = CommitteeFixture(size=4, workers=1)
+        f.parameters.sync_retry_delay = 0.1
+        store = NodeStorage(None).batch_store
+        requests: list[tuple[bytes, ...]] = []
+
+        class RecordingNetwork:
+            async def request(self, address, msg, timeout=None):
+                requests.append(tuple(msg.digests))
+                from narwhal_tpu.messages import WorkerBatchResponse
+
+                return WorkerBatchResponse(())
+
+        rx_cmd, tx_proc = Channel(16), Channel(16)
+        sync = WorkerSynchronizer(
+            f.authorities[0].public,
+            0,
+            f.committee,
+            f.worker_cache,
+            f.parameters,
+            store,
+            RecordingNetwork(),
+            rx_cmd,
+            tx_proc,
+            _watch(),
+        )
+        task = sync.spawn()
+        d_satisfied, d_missing = b"\x01" * 32, b"\x02" * 32
+        await rx_cmd.send(SynchronizeMsg((d_satisfied, d_missing), f.authorities[1].public))
+        for _ in range(100):
+            if requests:
+                break
+            await asyncio.sleep(0.01)
+        assert requests and set(requests[0]) == {d_satisfied, d_missing}
+
+        # The batch arrives (peer broadcast path writes the store).
+        store.write(d_satisfied, b"whatever")
+        baseline = len(requests)
+        await asyncio.sleep(0.35)  # several retry ticks
+        later = requests[baseline:]
+        assert later, "retry ticks should still chase the missing digest"
+        for req in later:
+            assert d_satisfied not in req, "satisfied digest was re-requested"
+            assert d_missing in req
+
+        task.cancel()
+
+    run(scenario())
