@@ -1,19 +1,26 @@
 """Committee-scale liveness sweep: boot N in-process nodes, sample commit
 progress over time, and account the control-plane wire cost per round.
 
-Extends the hand-rolled run behind `benchmark/results/n50_liveness.json` into
-a repeatable tool (the N=100 gate of ROADMAP item 1):
+Two transports:
+
+* **Real sockets** (default) — the loopback-TCP mode behind
+  `benchmark/results/n50_liveness.json`. A committee's vote mesh costs
+  ~2·N·(N-1) in-process fds, which hard-caps this mode near N=90 under the
+  container's RLIMIT_NOFILE (the `n100_liveness.json` EMFILE failure); a
+  preflight now fails fast with the arithmetic instead of dying mid-run.
+* **simnet** (`--simnet`) — the virtual-clock in-memory fabric
+  (narwhal_tpu/simnet): zero sockets, zero fds on the mesh, hundreds of
+  nodes in one process, `--duration` measured in *virtual* seconds (wall
+  cost is CPU only). This is the mode for N>90 committees.
 
     python -m benchmark.liveness --nodes 50 --duration 240
-    python -m benchmark.liveness --nodes 100 --duration 300 \
-        --out benchmark/results/n100_liveness.json
+    python -m benchmark.liveness --nodes 200 --simnet --duration 10 \
+        --out benchmark/results/simnet_n200_liveness.json
 
-No injected load: at these committee sizes on a small host each round is
-thousands of signed+sealed control messages, so the assertion is liveness
-(lockstep commits advancing on every node) and the headline wire metric is
-bytes per committed round — process-wide (WireStats, comparable with the
-pre-wire-diet seed) and per-primary by message type (the new
-wire_bytes_sent_total{msg_type=} counters).
+No injected load: at these committee sizes each round is thousands of
+signed control messages, so the assertion is liveness (lockstep commits
+advancing on every node) and the headline wire metric is bytes per
+committed round.
 """
 
 from __future__ import annotations
@@ -22,8 +29,37 @@ import argparse
 import asyncio
 import json
 import os
+import resource
 import sys
 import time
+
+
+def estimate_required_fds(nodes: int, workers: int) -> int:
+    """Upper-bound fd demand of an N-node, W-worker in-process committee
+    over real sockets. Every in-process TCP connection burns TWO fds (both
+    endpoints live here). Meshes: primary vote mesh N·(N-1) connections,
+    one same-id worker mesh per lane N·(N-1)·W, primary<->own-worker
+    control 2·N·W; plus listeners (primary, typed api, grpc api = 3 per
+    node; worker mesh + tx + grpc tx = 3 per worker) and a flat allowance
+    for stores/logs/jax."""
+    connections = nodes * (nodes - 1) * (1 + workers) + 2 * nodes * workers
+    listeners = nodes * (3 + 3 * workers)
+    return 2 * connections + listeners + 256
+
+
+def preflight_fd_check(nodes: int, workers: int) -> None:
+    """Fail fast (and actionably) instead of mid-run EMFILE — the
+    n100_liveness.json failure mode."""
+    soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    needed = estimate_required_fds(nodes, workers)
+    if needed > soft:
+        raise SystemExit(
+            f"liveness preflight: N={nodes} W={workers} needs ~{needed:,} "
+            f"fds (≈2·N·(N-1)·(1+W) mesh sockets + listeners) but "
+            f"RLIMIT_NOFILE is {soft:,}. Raise `ulimit -n`, shrink the "
+            "committee, or run this committee socket-free with --simnet "
+            "(virtual-clock in-memory transport; no fd cost, N=200+ fits)."
+        )
 
 
 async def run_liveness(args) -> dict:
@@ -31,6 +67,7 @@ async def run_liveness(args) -> dict:
     from narwhal_tpu.config import Parameters
     from narwhal_tpu.network.rpc import WireStats
 
+    preflight_fd_check(args.nodes, args.workers)
     cluster = Cluster(
         size=args.nodes,
         workers=args.workers,
@@ -40,18 +77,22 @@ async def run_liveness(args) -> dict:
         ),
     )
     t0 = time.time()
-    await cluster.start()
+    await cluster.start(args.nodes - args.faults)
     boot_s = time.time() - t0
-    print(f"booted {args.nodes} nodes in {boot_s:.0f}s", file=sys.stderr)
+    print(f"booted {args.nodes - args.faults} nodes in {boot_s:.0f}s", file=sys.stderr)
 
     def committed() -> list[float]:
         return [
-            a.metric("consensus_last_committed_round") for a in cluster.authorities
+            a.metric("consensus_last_committed_round")
+            for a in cluster.authorities
+            if a.primary is not None
         ]
 
     def primary_sent_by_type() -> dict[str, float]:
         out: dict[str, float] = {}
         for a in cluster.authorities:
+            if a.primary is None:
+                continue
             m = a.primary.registry.get("wire_bytes_sent_total")
             if m is None:
                 continue
@@ -84,6 +125,122 @@ async def run_liveness(args) -> dict:
         await cluster.shutdown()
 
     window = time.time() - t_start
+    return _record(
+        args, "in-process liveness", boot_s, samples, window,
+        rounds0, rounds1, wire0, wire1, egress0, egress1,
+        alive=args.nodes - args.faults,
+    )
+
+
+def run_liveness_simnet(args) -> dict:
+    """The same measurement over the simnet fabric: one process, zero
+    sockets, virtual time. Boots the committee, lets `--duration` VIRTUAL
+    seconds elapse, and reports the usual liveness/wire record plus the
+    wall cost and the fabric's event count."""
+    from narwhal_tpu.network import transport
+    from narwhal_tpu.network.rpc import WireStats
+    from narwhal_tpu.simnet import SimCluster, SimFabric, SimLoop
+
+    loop = SimLoop()
+    asyncio.set_event_loop(loop)
+    fabric = SimFabric(seed=args.seed)
+    transport.install(fabric)
+    t_wall = time.time()
+
+    async def drive() -> dict:
+        cluster = SimCluster(
+            size=args.nodes,
+            fabric=fabric,
+            workers=args.workers,
+            auth=not args.no_auth,
+            max_header_delay=args.max_header_delay,
+            max_batch_delay=args.max_batch_delay,
+        )
+        t0 = time.time()
+        await cluster.start(args.nodes - args.faults)
+        boot_s = time.time() - t0
+        print(
+            f"booted {args.nodes - args.faults} simnet nodes in {boot_s:.0f}s "
+            f"(wall)",
+            file=sys.stderr,
+        )
+
+        def committed() -> list[float]:
+            return [
+                a.metric("consensus_last_committed_round")
+                for a in cluster.authorities
+                if a.primary is not None
+            ]
+
+        def primary_sent_by_type() -> dict[str, float]:
+            out: dict[str, float] = {}
+            for a in cluster.authorities:
+                if a.primary is None:
+                    continue
+                m = a.primary.registry.get("wire_bytes_sent_total")
+                if m is None:
+                    continue
+                for k, c in m._children.items():
+                    out[k[0]] = out.get(k[0], 0.0) + c.value
+            return out
+
+        samples = []
+        wire0 = WireStats.snapshot()
+        egress0 = primary_sent_by_type()
+        rounds0 = committed()
+        v_start = loop.time()
+        ticks = max(1, int(args.duration / args.sample_interval))
+        for _ in range(ticks):
+            await asyncio.sleep(args.sample_interval)
+            rounds = committed()
+            samples.append(
+                {
+                    "t_virtual_s": round(loop.time() - v_start, 1),
+                    "committed_min": min(rounds),
+                    "committed_max": max(rounds),
+                    "wall_s": round(time.time() - t_wall, 1),
+                }
+            )
+            print(
+                f"  t={samples[-1]['t_virtual_s']}s(virtual) committed "
+                f"[{min(rounds)}, {max(rounds)}] wall={samples[-1]['wall_s']}s",
+                file=sys.stderr,
+            )
+        window = loop.time() - v_start
+        wire1 = WireStats.snapshot()
+        egress1 = primary_sent_by_type()
+        rounds1 = committed()
+        await cluster.shutdown()
+        record = _record(
+            args, "simnet liveness (virtual clock)", boot_s, samples, window,
+            rounds0, rounds1, wire0, wire1, egress0, egress1,
+            alive=args.nodes - args.faults,
+        )
+        record["virtual_duration_s"] = round(window, 1)
+        record["wall_s"] = round(time.time() - t_wall, 1)
+        record["real_sockets"] = 0
+        record["fabric_events"] = len(fabric.log)
+        record["transport_auth"] = not args.no_auth
+        record["seed"] = args.seed
+        return record
+
+    try:
+        return loop.run_until_complete(drive())
+    finally:
+        transport.uninstall()
+        pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+        for t in pending:
+            t.cancel()
+        if pending:
+            loop.run_until_complete(asyncio.wait(pending, timeout=15.0))
+        asyncio.set_event_loop(None)
+        loop.close()
+
+
+def _record(
+    args, mode, boot_s, samples, window, rounds0, rounds1, wire0, wire1,
+    egress0, egress1, alive,
+) -> dict:
     progressed = max(r1 - r0 for r0, r1 in zip(rounds0, rounds1))
     min_progress = min(r1 - r0 for r0, r1 in zip(rounds0, rounds1))
     wire_bytes = wire1["bytes_sent"] - wire0["bytes_sent"]
@@ -91,10 +248,12 @@ async def run_liveness(args) -> dict:
         k: round(egress1.get(k, 0.0) - egress0.get(k, 0.0), 1)
         for k in sorted(set(egress0) | set(egress1))
     }
-    record = {
-        "mode": "in-process liveness",
+    return {
+        "mode": mode,
         "committee_size": args.nodes,
         "workers_per_node": args.workers,
+        "faults": args.faults,
+        "alive_nodes": alive,
         "parameters": {
             "max_header_delay_s": args.max_header_delay,
             "max_batch_delay_s": args.max_batch_delay,
@@ -104,7 +263,7 @@ async def run_liveness(args) -> dict:
         "boot_s": round(boot_s, 1),
         "samples": samples,
         "committed_rounds_in_window": round(progressed, 1),
-        "committed_rounds_per_s": round(progressed / window, 4),
+        "committed_rounds_per_s": round(progressed / window, 4) if window else None,
         # The liveness gate: every node advanced, and min==max lockstep at
         # the final sample means nobody was left behind.
         "all_nodes_progressed": min_progress > 0,
@@ -116,28 +275,42 @@ async def run_liveness(args) -> dict:
         # Per-primary egress per round (committee aggregate / N / rounds):
         # the wire-diet acceptance metric, from the per-link counters.
         "primary_egress_bytes_per_round": (
-            round(sum(by_type.values()) / args.nodes / progressed, 1)
+            round(sum(by_type.values()) / alive / progressed, 1)
             if progressed
             else None
         ),
         "primary_egress_bytes_by_msg_type": by_type,
     }
-    return record
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(prog="benchmark.liveness")
     ap.add_argument("--nodes", type=int, default=50)
     ap.add_argument("--workers", type=int, default=1)
-    ap.add_argument("--duration", type=float, default=240.0)
+    ap.add_argument("--faults", type=int, default=0,
+                    help="boot N-faults nodes (reference bench parity)")
+    ap.add_argument("--duration", type=float, default=240.0,
+                    help="measurement window; VIRTUAL seconds under --simnet")
     ap.add_argument("--sample-interval", type=float, default=20.0)
     ap.add_argument("--max-header-delay", type=float, default=1.0)
     ap.add_argument("--max-batch-delay", type=float, default=0.5)
+    ap.add_argument("--simnet", action="store_true",
+                    help="socket-free virtual-clock transport: no fd "
+                    "ceiling, N=200+ committees fit in one process")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="simnet determinism seed")
+    ap.add_argument("--no-auth", action="store_true",
+                    help="simnet only: skip transport handshakes/AEAD "
+                    "(trusted in-memory medium; saves 2N(N-1) pure-Python "
+                    "X25519 exchanges at boot)")
     ap.add_argument("--note", default="")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    record = asyncio.run(run_liveness(args))
+    if args.simnet:
+        record = run_liveness_simnet(args)
+    else:
+        record = asyncio.run(run_liveness(args))
     if args.note:
         record["note"] = args.note
     print(json.dumps(record, indent=1))

@@ -48,8 +48,10 @@ def run_once(rate: int, args) -> dict:
     record["crypto_backend"] = args.crypto_backend
     record["dag_backend"] = args.dag_backend
     record["dag_shards"] = args.dag_shards
-    # Self-describing A/B rows: W is part of the experiment's identity.
+    # Self-describing A/B rows: W and the crash-fault count are part of the
+    # experiment's identity (the reference bench records `faults` too).
     record["workers_per_node"] = args.workers
+    record["faults"] = args.faults
     print(
         f"  rate {rate:>8,}: TPS {record['consensus_tps']:>10,.0f}  "
         f"lat {record['consensus_latency_ms']:>8,.0f} ms  "

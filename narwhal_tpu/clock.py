@@ -1,0 +1,31 @@
+"""The injected clock — every actor's single source of elapsed time.
+
+Actors (primary/, worker/, consensus/, executor/, network/) must never read
+the wall clock directly (`time.time()`, `time.monotonic()`, `loop.time()`):
+under the simnet harness (narwhal_tpu/simnet) the whole committee runs on a
+virtual-clock event loop whose `loop.time()` is simulated time, and a single
+stray `time.monotonic()` would mix wall time into pacing deadlines, retry
+backoffs and stage latencies — silently breaking both the determinism and
+the zero-wall-clock-wait property of simulated scenarios. The
+`no-wall-clock-in-actors` lint rule enforces the discipline; this module is
+the sanctioned read path.
+
+`now()` returns the running event loop's time (monotonic seconds; virtual
+under simnet, `time.monotonic()`-based otherwise) and falls back to
+`time.monotonic()` off-loop, so synchronous construction-time stamps keep
+working in plain scripts and tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+def now() -> float:
+    """Monotonic seconds on the actor clock: the running loop's time when
+    inside a loop (virtual under simnet), else `time.monotonic()`."""
+    try:
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return time.monotonic()

@@ -13,6 +13,7 @@ import asyncio
 import logging
 from dataclasses import replace
 
+from . import clock
 from .config import (
     Authority,
     Committee,
@@ -93,6 +94,7 @@ class Cluster:
         consensus_protocol: str = "bullshark",
         max_header_delay: float = 0.05,
         max_batch_delay: float = 0.05,
+        auth: bool = True,
     ):
         self.fixture = CommitteeFixture(size=size, workers=workers)
         # The delay kwargs override the fixture defaults (fast rounds for
@@ -117,7 +119,23 @@ class Cluster:
         self.dag_backend = dag_backend
         self.dag_shards = dag_shards
         self.consensus_protocol = consensus_protocol
-        # Pre-assign real ports so no early broadcast targets a placeholder.
+        # auth=False skips the transport handshake/AEAD layer: servers run
+        # open and clients connect plain. Only for harnesses where the
+        # medium itself is trusted (simnet's in-memory fabric at large N,
+        # where 2·N·(N-1) pure-Python X25519 handshakes dominate boot).
+        self.auth = auth
+        self._assign_addresses()
+        self.committee: Committee = self.fixture.committee
+        self.worker_cache: WorkerCache = self.fixture.worker_cache
+        self.authorities: list[AuthorityDetails] = [
+            AuthorityDetails(self, i, a.public)
+            for i, a in enumerate(self.fixture.authorities)
+        ]
+
+    def _assign_addresses(self) -> None:
+        """Pre-assign real loopback ports so no early broadcast targets a
+        placeholder. The simnet cluster overrides this with fabric-owned
+        synthetic addresses (zero sockets, zero fds)."""
         committee = self.fixture.committee
         for pk, auth in committee.authorities.items():
             committee.authorities[pk] = replace(
@@ -130,12 +148,11 @@ class Cluster:
                     transactions=f"127.0.0.1:{get_available_port()}",
                     worker_address=f"127.0.0.1:{get_available_port()}",
                 )
-        self.committee: Committee = committee
-        self.worker_cache: WorkerCache = self.fixture.worker_cache
-        self.authorities: list[AuthorityDetails] = [
-            AuthorityDetails(self, i, a.public)
-            for i, a in enumerate(self.fixture.authorities)
-        ]
+
+    def _commit_tap(self, index: int):
+        """Per-node commit observation hook handed to Consensus; the simnet
+        cluster records (epoch, round, digest) sequences for the oracles."""
+        return None
 
     def _store(self, index: int, role: str) -> NodeStorage:
         if self.store_base is None:
@@ -158,7 +175,8 @@ class Cluster:
             crypto_backend=self.crypto_backend,
             dag_backend=self.dag_backend,
             dag_shards=self.dag_shards,
-            network_keypair=fixture_auth.network_keypair,
+            network_keypair=fixture_auth.network_keypair if self.auth else None,
+            commit_tap=self._commit_tap(index),
         )
         await details.primary.spawn()
         for wid in range(self.fixture.workers_per_authority):
@@ -170,7 +188,9 @@ class Cluster:
                 self.parameters,
                 self._store(index, f"worker-{wid}"),
                 benchmark=self.benchmark,
-                network_keypair=fixture_auth.worker_keypairs[wid],
+                network_keypair=(
+                    fixture_auth.worker_keypairs[wid] if self.auth else None
+                ),
             )
             await wn.spawn()
             details.workers[wid] = wn
@@ -203,7 +223,7 @@ class Cluster:
         expected = expected_nodes or sum(
             1 for a in self.authorities if a.primary is not None
         )
-        deadline = asyncio.get_event_loop().time() + timeout
+        deadline = clock.now() + timeout
         while True:
             rounds = {
                 a.name: a.metric("consensus_last_committed_round")
@@ -213,7 +233,7 @@ class Cluster:
             ok = [r for r in rounds.values() if r >= commit_threshold]
             if len(ok) >= expected:
                 return rounds
-            if asyncio.get_event_loop().time() > deadline:
+            if clock.now() > deadline:
                 raise AssertionError(
                     f"no progress: committed rounds {rounds} < {commit_threshold}"
                 )

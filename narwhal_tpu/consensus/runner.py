@@ -36,6 +36,7 @@ class Consensus:
         gc_depth: Round,
         metrics=None,
         tx_accepted: Channel | None = None,  # non-blocking tap -> Prefetcher
+        commit_tap=None,  # callable(ConsensusOutput): observation hook
     ):
         self.committee = committee
         self.protocol = protocol
@@ -48,6 +49,10 @@ class Consensus:
         self.gc_depth = gc_depth
         self.metrics = metrics
         self.tx_accepted = tx_accepted
+        # Synchronous, non-blocking observation hook per committed output:
+        # the simnet safety/liveness oracles read the exact commit sequence
+        # here without adding a channel (and without racing the executor).
+        self.commit_tap = commit_tap
         self.consensus_index = consensus_store.last_consensus_index()
         self.state = ConsensusState.new_from_store(
             Certificate.genesis(committee),
@@ -153,6 +158,8 @@ class Consensus:
                 self.metrics.last_committed_round.set(self.state.last_committed_round)
                 self.metrics.committed_certificates.inc()
                 self.metrics.commit_timer.stop(cert.digest)
+            if self.commit_tap is not None:
+                self.commit_tap(output)
             await self.tx_primary.send(cert)
             await self.tx_output.send(output)
         if self.metrics is not None:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..clock import now
 from ..channels import Channel
 from ..stores import BatchStore
 from ..types import Batch, ConsensusOutput
@@ -79,13 +80,12 @@ class ExecutorCore:
 
     async def run(self) -> None:
         self.execution_indices = await self.execution_state.load_execution_indices()
-        loop = asyncio.get_running_loop()
         try:
             while True:
                 output, batches, t_commit = await self.rx_subscriber.recv()
                 await self.execute_certificate(output, batches)
                 if self.metrics is not None and t_commit is not None:
-                    dt = loop.time() - t_commit
+                    dt = now() - t_commit
                     self.metrics.commit_to_exec_latency.observe(dt)
                     self.metrics.stage_latency.labels("execute").observe(dt)
         except asyncio.CancelledError:

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..clock import now
 from ..channels import BoundedFuturesOrdered, Channel
 from ..config import WorkerCache
 from ..messages import RequestBatchesMsg, RequestedBatchesMsg
@@ -199,11 +200,10 @@ class Subscriber:
                 await self.tx_executor.send(staged)
 
         forwarder = asyncio.ensure_future(forward())
-        loop = asyncio.get_running_loop()
         try:
             while True:
                 output: ConsensusOutput = await self.rx_consensus.recv()
-                await pending.push(self._stage(output, loop.time()))
+                await pending.push(self._stage(output, now()))
         finally:
             # Cancel staged fetches too: their infinite-backoff retry loops
             # would otherwise keep hitting workers (and writing into our

@@ -190,6 +190,32 @@ if not _HAVE_OPENSSL:
     X25519PublicKey = _RefX25519PublicKey
 
 
+# Handshake entropy seam. Production draws from os.urandom; the simnet
+# scenario runner installs a seeded stream so handshake nonces/ephemerals —
+# and therefore the whole wire transcript — replay bit-identically per
+# seed. (Simulated committees run inside one trusted process; deterministic
+# ephemerals there cost nothing security-wise and buy exact replay.)
+_entropy = os.urandom
+
+
+def set_entropy(fn) -> "Callable[[int], bytes]":
+    """Install a bytes-producing entropy source (n -> n bytes); returns
+    the previous one so harnesses can restore it."""
+    global _entropy
+    previous = _entropy
+    _entropy = fn if fn is not None else os.urandom
+    return previous
+
+
+def _eph_private_key():
+    """A fresh X25519 ephemeral from the entropy seam (both the OpenSSL
+    and the in-tree backend accept raw 32-byte scalars)."""
+    raw = _entropy(32)
+    if _HAVE_OPENSSL:
+        return X25519PrivateKey.from_private_bytes(raw)
+    return X25519PrivateKey(raw)
+
+
 @dataclass
 class Peer:
     """Identity of the remote end of a connection, as seen by handlers:
@@ -346,8 +372,8 @@ async def client_handshake(
     if server_pub != expected_key:
         raise AuthError("server identity does not match committee network key")
     client_pub = credentials.keypair.public
-    nonce_c = os.urandom(32)
-    eph_priv = X25519PrivateKey.generate()
+    nonce_c = _entropy(32)
+    eph_priv = _eph_private_key()
     client_eph = _raw_x25519_pub(eph_priv)
     transcript = _transcript(
         nonce_s, nonce_c, server_pub, client_pub, server_eph, client_eph
@@ -375,9 +401,9 @@ async def server_handshake(
     """Server half: send HELLO with our ephemeral, verify the client's
     signed transcript, sign it back. Returns the client's verified network
     key and the frame-MAC session."""
-    nonce_s = os.urandom(32)
+    nonce_s = _entropy(32)
     server_pub = keypair.public
-    eph_priv = X25519PrivateKey.generate()
+    eph_priv = _eph_private_key()
     server_eph = _raw_x25519_pub(eph_priv)
     write_frame(writer, KIND_HELLO, 0, 0, nonce_s + server_pub + server_eph)
     await writer.drain()

@@ -30,6 +30,7 @@ from typing import Awaitable, Callable, Iterable
 
 from ..channels import CancelOnDrop
 from ..messages import Ack, decode_message, encode_message
+from . import transport
 from .auth import (
     KIND_HELLO,
     MAC_LEN,
@@ -371,7 +372,11 @@ class PeerClient:
             if self._writer is not None:
                 return
             host, port = self.address.rsplit(":", 1)
-            reader, writer = await asyncio.open_connection(host, int(port), limit=MAX_FRAME + 1024)
+            # Through the transport seam: real TCP normally, the simnet
+            # in-memory fabric when one is installed (simnet/fabric.py).
+            reader, writer = await transport.open_connection(
+                host, int(port), limit=MAX_FRAME + 1024
+            )
             # Resolve the expected identity at connect time so reconnects
             # after an epoch change see the current committee's keys.
             expected_key = (
@@ -556,6 +561,16 @@ class RpcServer:
         self._handlers[msg_cls.TAG] = (handler, allow)
 
     async def start(self, host: str, port: int) -> int:
+        # Simnet path first: the fabric owns the whole address namespace
+        # (no real ports, no placeholders, no fd budget) — every frame this
+        # server reads still goes through the same handshake/AEAD/dispatch
+        # code below, just over in-memory streams.
+        fabric = transport.active()
+        if fabric is not None:
+            self._server = await fabric.start_server(
+                self._on_connection, host, port, limit=MAX_FRAME + 1024
+            )
+            return self._server.sockets[0].getsockname()[1]
         # reuse_port lets the bind coexist with the allocator's SO_REUSEPORT
         # placeholder (config.get_available_port), which reserves
         # pre-assigned ports against ephemeral collisions; the placeholder

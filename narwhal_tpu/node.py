@@ -84,6 +84,7 @@ class PrimaryNode:
         dag_shards: int = 1,  # devices on the mesh's 'auth' axis (tpu backend)
         verify_shards: int = 1,  # devices on the verifier's 'data' axis (tpu)
         network_keypair: KeyPair | None = None,
+        commit_tap=None,  # callable(ConsensusOutput): simnet oracle hook
     ):
         self.keypair = keypair
         self.name: PublicKey = keypair.public
@@ -306,6 +307,7 @@ class PrimaryNode:
                 parameters.gc_depth,
                 self.consensus_metrics,
                 tx_accepted=self.tx_accepted_certificates,
+                commit_tap=commit_tap,
             )
             self.executor = Executor(
                 self.name,
@@ -413,13 +415,22 @@ class PrimaryNode:
             # without bound (the worker fails open if these pushes stop).
             self._tasks.append(asyncio.ensure_future(self._backpressure_monitor()))
         # gRPC owns the configured public address (tonic parity); the typed
-        # TCP api binds an ephemeral port for in-framework clients.
+        # TCP api binds an ephemeral port for in-framework clients. Under
+        # the simnet transport the typed api rides the fabric like every
+        # other RpcServer, but grpc.aio binds REAL sockets — skipped there,
+        # keeping simulated committees at zero sockets (the interop edge is
+        # meaningless inside a simulation anyway).
+        from .network import transport as _transport
+
         self.api.primary_address = self.primary.address
         self.api_address = await self.api.spawn("127.0.0.1:0")
-        self.grpc_api.primary_address = self.primary.address
-        self.grpc_api_address = await self.grpc_api.spawn(
-            self.parameters.consensus_api_grpc_address
-        )
+        if _transport.simnet_active():
+            self.grpc_api_address = ""
+        else:
+            self.grpc_api.primary_address = self.primary.address
+            self.grpc_api_address = await self.grpc_api.spawn(
+                self.parameters.consensus_api_grpc_address
+            )
         # Restart catch-up (block_synchronizer/mod.rs:75-83 SynchronizeRange):
         # collect certificates peers accumulated while we were down.
         last_round = self.storage.certificate_store.last_round()
@@ -450,8 +461,7 @@ class PrimaryNode:
         unreliable_send every poll interval — workers treat a silent
         primary as level 0 after backpressure_stale_after (fail open), so
         this task can die without wedging client ingest."""
-        import time as _time
-
+        from . import clock
         from .config import env_float
         from .messages import BackpressureMsg
         from .pacing import backpressure_level
@@ -480,15 +490,15 @@ class PrimaryNode:
         commit_counter = self.consensus_metrics.committed_certificates
         commit_timer = self.consensus_metrics.commit_timer
         last_committed = commit_counter.get()
-        last_commit_t = _time.monotonic()
+        last_commit_t = clock.now()
         while True:
             committed = commit_counter.get()
             if committed != last_committed:
-                last_committed, last_commit_t = committed, _time.monotonic()
+                last_committed, last_commit_t = committed, clock.now()
             level = backpressure_level(
                 (ch.occupancy() for ch in channels),
                 commit_timer.ewma,
-                (_time.monotonic() - last_commit_t) if committed > 0 else None,
+                (clock.now() - last_commit_t) if committed > 0 else None,
                 target,
                 self.parameters.backpressure_high_watermark,
             )

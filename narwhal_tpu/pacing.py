@@ -35,8 +35,9 @@ the owning actors call in from their existing select loops.
 from __future__ import annotations
 
 import asyncio
-import time
 from typing import Callable, Iterable
+
+from .clock import now as _now
 
 # The string the wire carries when ingest sheds: typed-RPC clients see it as
 # the RpcError text of the ERR frame, gRPC clients as the status detail of
@@ -145,7 +146,7 @@ class BackpressureState:
         low: float = 0.5,
         stale_after: float = 2.0,
         gauge=None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = _now,
     ):
         self.high = high
         self.low = max(0.0, min(low, high))
@@ -243,14 +244,14 @@ class IngestGate:
         if self.policy == "off" or self.admits():
             return
         if self.policy == "block":
-            deadline = time.monotonic() + self.block_timeout
-            t0 = time.monotonic()
-            while time.monotonic() < deadline:
+            t0 = _now()
+            deadline = t0 + self.block_timeout
+            while _now() < deadline:
                 await asyncio.sleep(self.block_poll)
                 if self.admits():
                     if self.metrics is not None:
                         self.metrics.ingest_blocked_seconds.observe(
-                            time.monotonic() - t0
+                            _now() - t0
                         )
                     return
             # Fall through: blocking past the timeout would just move the
@@ -274,7 +275,7 @@ class StageTimer:
         histogram,  # metrics.Histogram with a ("stage",) label
         stage: str,
         max_pending: int = 8192,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = _now,
         ewma_alpha: float = 0.2,
     ):
         self._child = histogram.labels(stage)

@@ -28,6 +28,7 @@ import logging
 import random
 from collections import defaultdict
 
+from ..clock import now
 from ..config import Committee, Parameters, WorkerCache
 from ..messages import (
     CertificatesBatchRequest,
@@ -242,8 +243,7 @@ class BlockSynchronizer:
         still-missing payload, so one unresponsive provider cannot stall the
         sync until the outer timeout."""
         timeout = timeout or self.parameters.sync_retry_delay * 4
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + timeout
+        deadline = now() + timeout
 
         def missing(cert: Certificate) -> bool:
             return any(
@@ -259,7 +259,7 @@ class BlockSynchronizer:
             )
 
         attempt = 0
-        while pending and loop.time() < deadline:
+        while pending and now() < deadline:
             await self._request_worker_sync(pending, providers, attempt)
             # Wait for arrivals until the retry tick, then rotate targets.
             waiters = [
@@ -269,7 +269,7 @@ class BlockSynchronizer:
                 if not self.payload_store.contains(bd, wid)
             ]
             interval = min(
-                self.parameters.sync_retry_delay, max(0.0, deadline - loop.time())
+                self.parameters.sync_retry_delay, max(0.0, deadline - now())
             )
             if waiters:
                 try:

@@ -18,6 +18,7 @@ import asyncio
 import logging
 from dataclasses import dataclass
 
+from ..clock import now
 from ..config import WorkerCache
 from ..messages import RequestBatchesMsg, RequestedBatchesMsg
 from ..network import NetworkClient, RpcError
@@ -148,10 +149,9 @@ class BlockWaiter:
         # One deadline covers ALL attempts: retries are for fast transport
         # failures (connection refused while a worker restarts) and must not
         # stretch the reference's hard per-batch bound.
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + self.batch_timeout
+        deadline = now() + self.batch_timeout
         for attempt in range(self.retry_attempts):
-            remaining = deadline - loop.time()
+            remaining = deadline - now()
             if remaining <= 0:
                 break
             try:
@@ -173,7 +173,7 @@ class BlockWaiter:
                 if attempt + 1 < self.retry_attempts:
                     await asyncio.sleep(
                         min(self.retry_delay * (attempt + 1),
-                            max(0.0, deadline - loop.time()))
+                            max(0.0, deadline - now()))
                     )
                 continue
             entries = {d: (found, raw) for d, found, raw in resp.batches}

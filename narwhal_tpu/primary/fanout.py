@@ -37,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..clock import now
 from ..channels import CancelOnDrop
 from ..config import Committee
 from ..crypto import digest256
@@ -194,7 +195,7 @@ class FanoutBroadcaster:
         acked: set[PublicKey] = set()
         self._acks[ack_id] = acked
         self._ack_round[ack_id] = round
-        self._ack_t0[ack_id] = asyncio.get_event_loop().time()
+        self._ack_t0[ack_id] = now()
         handles = []
         for child in children:
             handle = self.network.send(
@@ -224,7 +225,7 @@ class FanoutBroadcaster:
         acked.add(pk)
         t0 = self._ack_t0.get(ack_id)
         if t0 is not None:
-            latency = asyncio.get_event_loop().time() - t0
+            latency = now() - t0
             prev = self._ack_latency_ewma
             self._ack_latency_ewma = (
                 latency if prev is None else 0.2 * latency + 0.8 * prev

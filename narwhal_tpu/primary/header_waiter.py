@@ -179,7 +179,8 @@ class HeaderWaiter:
     # ------------------------------------------------------------------
     def _spawn_waiter(self, header: Header, coro) -> None:
         if header.digest in self.pending:
-            return  # already being repaired
+            coro.close()  # already being repaired; drop the duplicate quietly
+            return
         task = asyncio.ensure_future(coro)
         self.pending[header.digest] = (header.round, task)
 

@@ -15,9 +15,9 @@ from __future__ import annotations
 import asyncio
 import enum
 import logging
-import time
 
 from ..channels import Channel, Subscriber, Watch
+from ..clock import now
 from ..config import Committee
 from ..crypto import SignatureService
 from ..types import Certificate, Digest, Header, PublicKey, Round, WorkerId
@@ -122,7 +122,7 @@ class Proposer:
     # -- header construction ----------------------------------------------
     async def _make_header(self) -> None:
         if self.digests:
-            self._payload_seen_t = time.monotonic()
+            self._payload_seen_t = now()
         header = Header.build(
             self.name,
             self.round,
@@ -159,7 +159,7 @@ class Proposer:
         header path): a peer's payload-bearing header keeps THIS node's
         proposer on the floor cadence so the quorum advances rounds fast
         enough to commit it."""
-        self._payload_seen_t = time.monotonic()
+        self._payload_seen_t = now()
 
     def _header_delay(self) -> float:
         """The effective header delay for this loop iteration. With a
@@ -173,7 +173,7 @@ class Proposer:
         payload_active = (
             bool(self.digests)
             or not self.rx_workers.empty()
-            or time.monotonic() - self._payload_seen_t < self.payload_grace
+            or now() - self._payload_seen_t < self.payload_grace
         )
         if self.pacing is not None and payload_active:
             delay = self.pacing.delay()
@@ -186,7 +186,7 @@ class Proposer:
         return delay
 
     async def run(self) -> None:
-        last_header_t = time.monotonic()
+        last_header_t = now()
         parents_task = asyncio.ensure_future(self.rx_core.recv())
         digest_task = asyncio.ensure_future(self.rx_workers.recv())
         recon_task = asyncio.ensure_future(self.rx_reconfigure.changed())
@@ -198,7 +198,7 @@ class Proposer:
                 timer_deadline = last_header_t + self._header_delay()
                 enough_parents = bool(self.last_parents)
                 enough_digests = self.payload_size >= self.header_size
-                timer_expired = time.monotonic() >= timer_deadline
+                timer_expired = now() >= timer_deadline
                 # The timer overrides the leader gating so the DAG cannot
                 # stall when the leader is slow or faulty (proposer.rs:219-252).
                 if (timer_expired or (enough_digests and self._ready())) and enough_parents:
@@ -209,14 +209,14 @@ class Proposer:
                         self.metrics.current_round.set(self.round)
                     logger.debug("Dag moved to round %s", self.round)
                     await self._make_header()
-                    last_header_t = time.monotonic()
+                    last_header_t = now()
                     timer_deadline = last_header_t + self._header_delay()
 
                 # Past the deadline nothing changes until a message lands:
                 # wait un-timed instead of polling with timeout=0 (with
                 # floor-level delays that poll would busy-yield the loop
                 # for the whole parent-quorum wait).
-                remaining = timer_deadline - time.monotonic()
+                remaining = timer_deadline - now()
                 timeout = None if remaining <= 0 else remaining
                 done, _ = await asyncio.wait(
                     {parents_task, digest_task, recon_task},

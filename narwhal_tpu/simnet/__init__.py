@@ -1,0 +1,63 @@
+"""simnet — deterministic adversary & fault-simulation harness.
+
+A seeded, virtual-clock, socket-free network for whole-committee
+simulation: the production protocol stack (actors, framing, handshakes,
+AEAD) runs unmodified over an in-memory fabric behind the
+`network/transport.py` seam, driven by an event loop whose time is
+simulated (`SimLoop`). Scenarios — partitions, link jitter/loss, crashes,
+worker loss, byzantine equivocation, epoch reconfiguration under traffic —
+are declared as a `FaultPlan` and replay bit-identically per seed.
+
+    from narwhal_tpu.simnet import (
+        FaultPlan, Partition, Crash, Equivocate, run_scenario, oracles,
+    )
+
+    result = run_scenario(nodes=4, duration=5.0, plan=FaultPlan(
+        seed=7, events=(Partition(at=1.0, heal=3.0, groups=((0, 1), (2, 3))),),
+    ))
+    oracles.assert_safety(result.commits)
+    oracles.assert_liveness(result.rounds,
+                            result.round_marks["heal@3.0"], min_rounds=2)
+
+See README § "Fault simulation" for the grammar, oracle semantics, and the
+determinism guarantees.
+"""
+
+from . import oracles
+from .byzantine import Equivocator
+from .clock import SimDeadlockError, SimLoop
+from .cluster import SimCluster, node_id
+from .fabric import CURRENT_NODE, EventLog, SimFabric
+from .plan import (
+    Crash,
+    Equivocate,
+    FaultPlan,
+    LinkFault,
+    LinkSpec,
+    Partition,
+    Reconfigure,
+    WorkerLoss,
+)
+from .scenario import ScenarioResult, run_scenario
+
+__all__ = [
+    "CURRENT_NODE",
+    "Crash",
+    "Equivocate",
+    "Equivocator",
+    "EventLog",
+    "FaultPlan",
+    "LinkFault",
+    "LinkSpec",
+    "Partition",
+    "Reconfigure",
+    "ScenarioResult",
+    "SimCluster",
+    "SimDeadlockError",
+    "SimFabric",
+    "SimLoop",
+    "WorkerLoss",
+    "node_id",
+    "oracles",
+    "run_scenario",
+]

@@ -1,0 +1,109 @@
+"""SimCluster — a whole committee on the simnet fabric.
+
+Same assembly as `narwhal_tpu.cluster.Cluster` (real PrimaryNode/WorkerNode
+actors, real stores, real frames), with three substitutions:
+
+* **addresses are synthetic** (`nodeI:port` strings owned by the fabric) —
+  no `get_available_port` probing, no placeholder sockets, no fds. The
+  fabric learns which node owns which address at assignment time, which is
+  what partitions and crash isolation key on;
+* **every node's tasks carry its identity**: `start_node` sets the
+  `CURRENT_NODE` context variable around construction + spawn, so every
+  task an actor ever spawns — including lazy reconnects rounds later —
+  attributes its connections to the right node;
+* **commits are recorded**: the per-node Consensus commit tap appends
+  `(epoch, round, certificate digest)` to `commits[i]` and mirrors a
+  compact entry into the fabric's event log, giving the safety/liveness
+  oracles the exact committed sequence without an extra channel.
+
+`crash_node` / `restart_node` model fail-stop: the fabric isolates the node
+first (connections reset, connects refused — no goodbye messages escape),
+then the node object is torn down; restart builds a fresh node with a fresh
+in-memory store, exercising the catch-up path.
+"""
+
+from __future__ import annotations
+
+from ..cluster import AuthorityDetails, Cluster
+from ..config import WorkerInfo
+from dataclasses import replace
+from .fabric import CURRENT_NODE, SimFabric
+
+
+def node_id(index: int) -> str:
+    return f"node{index}"
+
+
+class SimCluster(Cluster):
+    def __init__(self, size: int = 4, fabric: SimFabric | None = None, **kwargs):
+        self.fabric = fabric or SimFabric()
+        # (epoch, round, digest-hex) per node, in exact commit order.
+        self.commits: list[list[tuple[int, int, str]]] = [
+            [] for _ in range(size)
+        ]
+        super().__init__(size=size, **kwargs)
+
+    def _assign_addresses(self) -> None:
+        committee = self.fixture.committee
+        port = 0
+        for i, fixture_auth in enumerate(self.fixture.authorities):
+            pk = fixture_auth.public
+            port += 1
+            addr = f"{node_id(i)}:{port}"
+            committee.authorities[pk] = replace(
+                committee.authorities[pk], primary_address=addr
+            )
+            addrs = [addr]
+            ws = self.fixture.worker_cache.workers[pk]
+            for wid, info in ws.items():
+                port += 2
+                tx_addr = f"{node_id(i)}:{port - 1}"
+                w_addr = f"{node_id(i)}:{port}"
+                ws[wid] = WorkerInfo(
+                    name=info.name, transactions=tx_addr, worker_address=w_addr
+                )
+                addrs += [tx_addr, w_addr]
+            self.fabric.register_node(node_id(i), addrs)
+
+    def _commit_tap(self, index: int):
+        record = self.commits[index].append
+        log = self.fabric.log
+
+        def tap(output) -> None:
+            cert = output.certificate
+            entry = (cert.epoch, cert.round, cert.digest.hex())
+            record(entry)
+            log.append("commit", node_id(index), *entry)
+
+        return tap
+
+    async def start_node(self, index: int) -> AuthorityDetails:
+        token = CURRENT_NODE.set(node_id(index))
+        try:
+            return await super().start_node(index)
+        finally:
+            CURRENT_NODE.reset(token)
+
+    async def crash_node(self, index: int) -> None:
+        """Fail-stop: isolate on the fabric first (peers see resets and
+        refused reconnects, never a clean goodbye), then tear down."""
+        self.fabric.set_node_down(node_id(index), True)
+        await self.stop_node(index)
+
+    async def restart_node(self, index: int) -> AuthorityDetails:
+        self.fabric.set_node_down(node_id(index), False)
+        if self.authorities[index].primary is not None:
+            await self.stop_node(index)
+        # A node restarted with a fresh in-memory store recommits its DAG
+        # from genesis (deterministic ordering makes the replay identical),
+        # so its observation record starts a fresh segment — the safety
+        # oracle then checks the replayed sequence against the others'
+        # full sequences, which is exactly the prefix property.
+        self.commits[index].clear()
+        return await self.start_node(index)
+
+    def committed_rounds(self) -> list[float]:
+        return [
+            a.metric("consensus_last_committed_round") if a.primary else 0.0
+            for a in self.authorities
+        ]

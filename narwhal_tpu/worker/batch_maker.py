@@ -13,9 +13,9 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
-import time
 
 from ..channels import Channel, Subscriber, Watch
+from ..clock import now
 from ..types import SealedBatch, assemble_serialized_batch, iter_serialized_batch_txs
 
 logger = logging.getLogger("narwhal.worker")
@@ -78,10 +78,10 @@ class BatchMaker:
         # effective delay (batch_maker.rs:77-122 uses an interval timer).
         # The deadline is recomputed from `last_seal` each iteration so a
         # pacing change (queues draining/filling) takes effect mid-wait.
-        last_seal = time.monotonic()
+        last_seal = now()
         while True:
             deadline = last_seal + self._seal_delay()
-            timeout = max(0.0, deadline - time.monotonic())
+            timeout = max(0.0, deadline - now())
             try:
                 # Receives whole client bursts as (count, frames) chunks in
                 # wire form: one channel hop and zero per-tx work per burst.
@@ -91,19 +91,19 @@ class BatchMaker:
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
                 if not self._pending:
-                    self._pending_t0 = time.monotonic()
+                    self._pending_t0 = now()
                 self._pending.append(frames)
                 self._pending_count += count
                 self._pending_bytes += len(frames) - 4 * count
                 if self._pending_bytes >= self.batch_size:
                     await self._seal()
-                    last_seal = time.monotonic()
+                    last_seal = now()
             except asyncio.TimeoutError:
                 if self.rx_reconfigure.peek().kind == "shutdown":
                     return
                 if self._pending:
                     await self._seal()
-                last_seal = time.monotonic()
+                last_seal = now()
 
     async def _seal(self) -> None:
         serialized = assemble_serialized_batch(self._pending_count, self._pending)
@@ -127,6 +127,6 @@ class BatchMaker:
             self.metrics.created_batch_size.observe(size)
             self.metrics.batches_made.inc()
         if self._seal_stage is not None and self._pending_t0 is not None:
-            self._seal_stage.observe(time.monotonic() - self._pending_t0)
+            self._seal_stage.observe(now() - self._pending_t0)
         self._pending_t0 = None
         await self.tx_message.send(batch)

@@ -11,9 +11,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 from ..channels import Channel, Subscriber, Watch, drain_cancelled
+from ..clock import now as clock_now
 from ..config import Committee, Parameters, WorkerCache
 from ..messages import SynchronizeMsg, WorkerBatchRequest, WorkerBatchResponse
 from ..network import NetworkClient, RpcError
@@ -94,9 +94,9 @@ class WorkerSynchronizer:
 
     async def _synchronize(self, msg: SynchronizeMsg) -> None:
         missing = [d for d in msg.digests if not self.store.contains(d)]
-        now = time.monotonic()
+        t_now = clock_now()
         for d in missing:
-            self.pending[d] = (self.gc_round, msg.target, now)
+            self.pending[d] = (self.gc_round, msg.target, t_now)
         if self.metrics is not None:
             self.metrics.pending_sync_batches.set(len(self.pending))
         if not missing:

@@ -182,14 +182,22 @@ class Worker:
         # Interoperable gRPC ingest (the reference's tonic Transactions
         # service, worker.rs:369-423) alongside the high-throughput typed
         # ingest; ephemeral port, surfaced via grpc_transactions_address.
-        from ..grpc_api import GrpcTransactions
+        # grpc.aio binds a REAL socket, so it is skipped under the simnet
+        # transport (simulated committees are zero-socket by contract; the
+        # typed ingest above already rides the fabric).
+        from ..network import transport as _transport
 
-        self.grpc_transactions = GrpcTransactions(
-            self.tx_batch_maker, self.metrics, gate=self.ingest_gate
-        )
-        self.grpc_transactions_address = await self.grpc_transactions.spawn(
-            f"{thost}:0"
-        )
+        if _transport.simnet_active():
+            self.grpc_transactions_address = ""
+        else:
+            from ..grpc_api import GrpcTransactions
+
+            self.grpc_transactions = GrpcTransactions(
+                self.tx_batch_maker, self.metrics, gate=self.ingest_gate
+            )
+            self.grpc_transactions_address = await self.grpc_transactions.spawn(
+                f"{thost}:0"
+            )
 
         # Route the three planes with the authorization matrix: batch planes
         # accept same-lane workers of any committee member, the control plane

@@ -1,0 +1,13 @@
+"""Clean fixture for no-wall-clock-in-actors: elapsed time through the
+injected clock only; wall-clock modules may be imported (e.g. for
+formatting) as long as nothing reads them for elapsed time."""
+
+from narwhal_tpu.clock import now
+
+
+async def deadline_loop(channel):
+    t0 = now()
+    deadline = now() + 5.0
+    while now() < deadline:
+        await channel.recv()
+    return now() - t0

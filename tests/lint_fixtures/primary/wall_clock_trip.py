@@ -1,0 +1,20 @@
+"""Tripping fixture for no-wall-clock-in-actors: five direct wall-clock
+reads an actor module must not contain (the injected clock is
+narwhal_tpu.clock.now): time.time, time.monotonic, an aliased from-import,
+loop.time() through a loop-named variable, and the chained
+asyncio.get_event_loop().time() form."""
+
+import asyncio
+import time
+from time import monotonic as mono
+
+
+async def deadline_loop(channel):
+    t0 = time.time()  # trip 1: wall clock
+    last = time.monotonic()  # trip 2: monotonic wall clock
+    start = mono()  # trip 3: aliased from-import
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + 5.0  # trip 4: loop.time via a loop-named var
+    while asyncio.get_event_loop().time() < deadline:  # trip 5: chained form
+        await channel.recv()
+    return t0, last, start

@@ -57,3 +57,31 @@ def test_sweep_table_finds_knee():
     table = render_table(results)
     assert "knee: ~26,000" in table
     assert "| 5,000 |" in table
+
+
+def test_fd_preflight_estimates_and_fails_fast(monkeypatch):
+    """The liveness preflight: N=100 W=1 demands ~2·N·(N-1)·2 fds (the
+    n100_liveness.json EMFILE at ~19.8k mesh sockets under a 20k limit),
+    and the check fails BEFORE boot with a message pointing at --simnet."""
+    import resource
+
+    import pytest
+
+    from benchmark.liveness import estimate_required_fds, preflight_fd_check
+
+    # The estimate must at least cover the measured N=100 failure (~19.8k
+    # mesh sockets => ~40k fds both-endpoints-in-process).
+    assert estimate_required_fds(100, 1) > 19_800
+    # Monotone in both axes.
+    assert estimate_required_fds(100, 2) > estimate_required_fds(100, 1)
+    assert estimate_required_fds(200, 1) > estimate_required_fds(100, 1)
+
+    monkeypatch.setattr(
+        resource, "getrlimit", lambda which: (20_000, 20_000)
+    )
+    with pytest.raises(SystemExit) as err:
+        preflight_fd_check(100, 1)
+    msg = str(err.value)
+    assert "--simnet" in msg and "RLIMIT_NOFILE" in msg
+    # A committee that fits passes silently.
+    preflight_fd_check(10, 1)

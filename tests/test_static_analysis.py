@@ -92,6 +92,7 @@ EXPECTED_RULES = {
     "no-sync-store-write-in-async",
     "no-per-item-rpc-in-loop",
     "no-unbounded-channel",
+    "no-wall-clock-in-actors",
 }
 
 FIXTURE_FOR = {
@@ -118,6 +119,10 @@ FIXTURE_FOR = {
     "no-unbounded-channel": (
         "worker/unbounded_channel_trip.py",
         "worker/unbounded_channel_clean.py",
+    ),
+    "no-wall-clock-in-actors": (
+        "primary/wall_clock_trip.py",
+        "primary/wall_clock_clean.py",
     ),
 }
 
@@ -159,6 +164,8 @@ def test_fixture_finding_counts():
         "no-sync-store-write-in-async": 4,  # store write/put, engine batch, bare store
         "no-per-item-rpc-in-loop": 3,  # for+attr recv, async for, bare name
         "no-unbounded-channel": 3,  # bare, keyword-only gauge, attr form
+        # time.time, time.monotonic, aliased import, loop var, chained call
+        "no-wall-clock-in-actors": 5,
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -10,6 +10,7 @@
 | no-silent-except          | swallowed failures in the consensus-critical dirs |
 | no-per-item-rpc-in-loop   | RTT x items serialization on the commit data plane|
 | no-unbounded-channel      | default-capacity edges defeating admission control|
+| no-wall-clock-in-actors   | wall time leaking past the simnet virtual clock   |
 
 Rules are pure `ast` visitors over one `Module` at a time; registration is
 import-time via the `@register` decorator so `RULES` is the single catalog
@@ -907,3 +908,79 @@ class NoSilentExcept(Rule):
                     if dotted(f) in ("warnings.warn", "traceback.print_exc"):
                         return True
         return False
+
+
+# ---------------------------------------------------------------------------
+# no-wall-clock-in-actors
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoWallClockInActors(Rule):
+    name = "no-wall-clock-in-actors"
+    summary = (
+        "in primary/, worker/, consensus/, executor/ and network/: direct "
+        "wall-clock reads (time.time / time.monotonic / time.perf_counter "
+        "/ loop.time()) bypass the injected clock (narwhal_tpu/clock.now) "
+        "— under the simnet virtual-clock harness a single stray read "
+        "mixes wall time into pacing deadlines and retry backoffs, "
+        "breaking both determinism and the zero-wall-clock-wait property"
+    )
+
+    _SCOPED_DIRS = frozenset(
+        {"primary", "worker", "consensus", "executor", "network"}
+    )
+    _TIME_FUNCS = frozenset(
+        {
+            "time.time",
+            "time.monotonic",
+            "time.perf_counter",
+            "time.time_ns",
+            "time.monotonic_ns",
+            "time.perf_counter_ns",
+        }
+    )
+    _LOOP_GETTERS = frozenset(
+        {"asyncio.get_event_loop", "asyncio.get_running_loop"}
+    )
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, aliases)
+            if target in self._TIME_FUNCS:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"`{target}()` reads the wall clock directly — go "
+                    "through the injected clock (narwhal_tpu.clock.now) so "
+                    "simnet's virtual time stays sound",
+                )
+                continue
+            # loop.time(): any `<x>.time()` where <x> is a loop-ish name or
+            # a direct get_event_loop()/get_running_loop() call.
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"
+            ):
+                continue
+            base = node.func.value
+            loopish = (
+                isinstance(base, ast.Name) and "loop" in base.id.lower()
+            ) or (
+                isinstance(base, ast.Call)
+                and resolve(base.func, aliases) in self._LOOP_GETTERS
+            )
+            if loopish:
+                yield self.finding(
+                    mod,
+                    node,
+                    "`loop.time()` in an actor bypasses the injected clock "
+                    "(narwhal_tpu.clock.now); the two agree at runtime but "
+                    "only the clock seam keeps the discipline greppable "
+                    "and simnet-sound",
+                )
