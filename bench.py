@@ -267,4 +267,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--multichip" in sys.argv:
+        # The multi-chip device-plane leg: per-device-count sweep (1/2/4/8
+        # virtual devices, each in its own subprocess) ->
+        # benchmark/results/multichip_scaling.json with per-(kernel, mesh
+        # shape) compile walls. See benchmark/multichip.py.
+        from benchmark.multichip import main as multichip_main
+
+        multichip_main([a for a in sys.argv[1:] if a != "--multichip"])
+    else:
+        main()

@@ -1,0 +1,259 @@
+"""Multi-chip device-plane scaling sweep: the bench.py multichip leg.
+
+`python bench.py --multichip` (or `python -m benchmark.multichip`) runs a
+per-device-count sweep over the virtual CPU mesh — each device count in
+its OWN subprocess, because --xla_force_host_platform_device_count is
+fixed at jax initialization — and writes
+`benchmark/results/multichip_scaling.json`:
+
+- per device count: the sharded verify throughput (staged msm pipeline,
+  fixed bucket, median of timed steady-state dispatch windows) and the
+  per-(kernel, mesh shape) compile walls from the kernel registry;
+- for the acceptance device count (8): the full `__graft_entry__`
+  dryrun_multichip contract (rc recorded — the MULTICHIP artifact's
+  rc=124 compile-timeout failure mode is exactly what this leg guards),
+  run TWICE when the persistent cache is enabled so the warm-process
+  walls prove the once-per-container compile claim;
+- an honest scaling note: on this host every "device" is a virtual CPU
+  device sharing ONE physical core, so aggregate throughput cannot scale
+  with device count — the curve validates compile scaling, sharding
+  correctness and dispatch overhead, and the roofline arithmetic for a
+  real multi-chip part is spelled out in the note.
+
+The subprocesses opt in to the persistent compilation cache
+(NARWHAL_JAX_CACHE_DIR, default `<repo>/.jax_cache_multichip`) so the
+sweep pays each (kernel, mesh shape) compile once per container.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "benchmark", "results", "multichip_scaling.json")
+MARK = "MULTICHIP-LEG-RESULT "
+
+BUCKET = 512  # fixed verify bucket: divisible by every swept device count
+LEG_TIMEOUT = 1800.0
+
+
+def _leg_env(n_devices: int, cache_dir: str | None) -> dict:
+    import re
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", "")
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={max(8, n_devices)}"
+    ).strip()
+    env["NARWHAL_TPU_PREWARM"] = "0"
+    if cache_dir:
+        env["NARWHAL_JAX_CACHE_DIR"] = cache_dir
+    else:
+        env.pop("NARWHAL_JAX_CACHE_DIR", None)
+    return env
+
+
+def _run_leg(n_devices: int, dryrun: bool, cache_dir: str | None) -> dict:
+    """One device count in a fresh subprocess; returns its result record
+    (rc, walls, verify rate), with rc != 0 surfaced, never swallowed."""
+    cmd = [
+        sys.executable,
+        "-m",
+        "benchmark.multichip",
+        "--leg",
+        str(n_devices),
+    ]
+    if dryrun:
+        cmd.append("--dryrun")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd,
+            cwd=REPO,
+            env=_leg_env(n_devices, cache_dir),
+            capture_output=True,
+            text=True,
+            timeout=LEG_TIMEOUT,
+        )
+        rc = proc.returncode
+        out = proc.stdout
+        tail = (proc.stdout + proc.stderr)[-1500:]
+    except subprocess.TimeoutExpired as e:
+        rc, out = 124, (e.stdout or "")
+        tail = ((e.stdout or "") + (e.stderr or ""))[-1500:]
+    record: dict = {
+        "n_devices": n_devices,
+        "rc": rc,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "dryrun_included": dryrun,
+    }
+    for line in out.splitlines():
+        if line.startswith(MARK):
+            record.update(json.loads(line[len(MARK):]))
+            break
+    else:
+        record["tail"] = tail
+    return record
+
+
+def leg_main(n_devices: int, dryrun: bool) -> None:
+    """Subprocess body: sharded verify rate + compile walls (+ the driver
+    dryrun contract when --dryrun). Emits ONE marked JSON line."""
+    import numpy as np  # noqa: F401  (jax import ordering)
+
+    import jax
+
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu.tpu import kernel_registry
+    from narwhal_tpu.tpu.verifier import TpuVerifier, data_mesh
+
+    t_start = time.perf_counter()
+    result: dict = {"cache_dir": os.environ.get("NARWHAL_JAX_CACHE_DIR", "")}
+
+    if dryrun:
+        import __graft_entry__
+
+        t0 = time.perf_counter()
+        __graft_entry__.dryrun_multichip(n_devices, devices=jax.devices("cpu"))
+        result["dryrun_wall_s"] = round(time.perf_counter() - t0, 1)
+
+    kp = KeyPair.generate()
+    items = [
+        (kp.public, b"mc%d" % i, kp.sign(b"mc%d" % i)) for i in range(BUCKET)
+    ]
+    # data_mesh(1) at n=1: the curve isolates device-count scaling on ONE
+    # code path (the staged mesh pipeline) instead of comparing the
+    # monolithic single-chip kernel against the staged one.
+    mesh = data_mesh(n_devices)
+    verifier = TpuVerifier(
+        max_bucket=BUCKET,
+        msm_min_bucket=16,
+        mode="msm",
+        fixed_bucket=True,
+        mesh=mesh,
+    )
+    t0 = time.perf_counter()
+    ok = verifier(items)  # first dispatch: trace + compile + run
+    compile_wall = time.perf_counter() - t0
+    if not all(ok):
+        raise SystemExit("sharded verifier rejected a valid batch")
+
+    # Steady state: pipelined submit/collect pairs (depth 2), median of
+    # timed windows — the same shape bench.py's e2e loop uses, minus the
+    # tunnel. On virtual CPU devices this is a 1-core aggregate.
+    handles = [verifier.submit(items) for _ in range(2)]
+    rates = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(2):
+            out = verifier.collect(handles.pop(0))
+            if not all(out):
+                raise SystemExit("steady-state verify verdicts changed")
+            handles.append(verifier.submit(items))
+        rates.append(2 * BUCKET / (time.perf_counter() - t0))
+    for h in handles:
+        verifier.collect(h)
+    rates.sort()
+
+    result.update(
+        {
+            "bucket": BUCKET,
+            "verify_per_s": round(rates[len(rates) // 2], 1),
+            "verify_per_s_min": round(rates[0], 1),
+            "verify_per_s_max": round(rates[-1], 1),
+            "first_dispatch_wall_s": round(compile_wall, 1),
+            "compile_walls_s": kernel_registry.compile_walls_by_shape(),
+            "compile_walls_detail": kernel_registry.compile_walls(),
+            "total_wall_s": round(time.perf_counter() - t_start, 1),
+        }
+    )
+    print(MARK + json.dumps(result), flush=True)
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--leg" in argv:
+        i = argv.index("--leg")
+        leg_main(int(argv[i + 1]), "--dryrun" in argv)
+        return
+
+    cache_dir = os.environ.get(
+        "NARWHAL_JAX_CACHE_DIR", os.path.join(REPO, ".jax_cache_multichip")
+    )
+    legs = []
+    for n in (1, 2, 4, 8):
+        legs.append(_run_leg(n, dryrun=(n == 8), cache_dir=cache_dir))
+        print(
+            f"[multichip] n={n} rc={legs[-1]['rc']} "
+            f"verify/s={legs[-1].get('verify_per_s')} "
+            f"wall={legs[-1]['wall_s']}s",
+            flush=True,
+        )
+    # Warm-cache rerun of the acceptance leg: with the persistent cache
+    # populated, the same process-fresh 8-device leg must be dominated by
+    # deserialization, proving the once-per-container compile claim (and
+    # exercising the r5 cache-load crash path deliberately, in a
+    # subprocess, where a loader crash would surface as rc != 0).
+    warm = _run_leg(8, dryrun=True, cache_dir=cache_dir)
+    print(
+        f"[multichip] n=8 (warm cache) rc={warm['rc']} wall={warm['wall_s']}s",
+        flush=True,
+    )
+
+    base = next((l.get("verify_per_s") for l in legs if l["n_devices"] == 1), None)
+    curve = {
+        str(l["n_devices"]): (
+            round(l["verify_per_s"] / base, 2)
+            if base and l.get("verify_per_s")
+            else None
+        )
+        for l in legs
+    }
+    payload = {
+        "metric": "multichip_verify_scaling",
+        "bucket": BUCKET,
+        "legs": legs,
+        "warm_cache_leg": warm,
+        "scaling_vs_1_device": curve,
+        "ok": all(l["rc"] == 0 for l in legs) and warm["rc"] == 0,
+        "note": (
+            "All device counts are VIRTUAL CPU devices "
+            "(--xla_force_host_platform_device_count) sharing this "
+            "container's single physical core, so aggregate verify "
+            "throughput cannot exceed the 1-core rate at any device count "
+            "— the measured curve validates compile scaling (per-shape "
+            "walls recorded per leg; registry guarantees one compile per "
+            "(kernel, mesh shape)), sharding correctness and dispatch "
+            "overhead, not silicon scaling. Roofline for a real 8-chip "
+            "part: the staged msm pipeline is embarrassingly parallel "
+            "over the data axis except one [4, NLIMB, W] cross-device "
+            "reduce per bucket (~"
+            + str(4 * 20 * 64 * 4)
+            + " bytes/device) and the shared host Horner epilogue "
+            "(~9 ms per 32k batch, BENCH_r05), so device-only scaling is "
+            "min(K, device_rate*K / epilogue_rate): with BENCH_r05's "
+            "286k/s single-chip device rate and the 3.6M/s epilogue "
+            "ceiling (32768/9.14ms), 8 chips project to ~8x device "
+            "compute, epilogue-capped at ~12.5x - i.e. >=4x at 8 devices "
+            "holds on real silicon; this 1-core container measures ~1x "
+            "by construction."
+        ),
+    }
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    print(f"[multichip] wrote {RESULTS} ok={payload['ok']}", flush=True)
+    if not payload["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

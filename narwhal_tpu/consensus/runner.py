@@ -103,12 +103,13 @@ class Consensus:
                             break
                         certs.append(extra)
                     cert_task = asyncio.ensure_future(self.rx_new_certificates.recv())
+                    batch: list[Certificate] = []
                     for certificate in certs:
                         if certificate.epoch != self.committee.epoch:
                             continue  # stale epoch, drop
                         if self.metrics is not None:
                             # Stage tracing: acceptance -> sequenced in a
-                            # committed causal history (_process stops it).
+                            # committed causal history (_emit stops it).
                             self.metrics.commit_timer.start(certificate.digest)
                         if self.tx_accepted is not None:
                             # Speculative prefetch tap: batch digests are
@@ -122,7 +123,21 @@ class Consensus:
                                 and self.metrics is not None
                             ):
                                 self.metrics.accepted_tap_dropped.inc()
-                        await self._process(certificate)
+                        batch.append(certificate)
+                    if len(batch) > 1 and hasattr(
+                        self.protocol, "process_batch_async"
+                    ):
+                        # Device-backed burst path: one batched window
+                        # scatter + per-event dispatches with readbacks
+                        # deferred one event (the fused pipeline), instead
+                        # of one full dispatch round trip per certificate.
+                        sequence = await self.protocol.process_batch_async(
+                            self.state, self.consensus_index, batch
+                        )
+                        await self._emit(sequence)
+                    else:
+                        for certificate in batch:
+                            await self._process(certificate)
         finally:
             recon_task.cancel()
             cert_task.cancel()
@@ -138,6 +153,9 @@ class Consensus:
             sequence = self.protocol.process_certificate(
                 self.state, self.consensus_index, certificate
             )
+        await self._emit(sequence)
+
+    async def _emit(self, sequence: list[ConsensusOutput]) -> None:
         if sequence:
             self.consensus_index = sequence[-1].consensus_index + 1
         for output in sequence:

@@ -236,6 +236,7 @@ class PrimaryNode:
         self.consensus: Consensus | None = None
         self.executor: Executor | None = None
         self.dag: Dag | None = None
+        self._dag_backend = dag_backend
         self.execution_state = execution_state or SimpleExecutionState(storage)
         if dag_shards > 1 and dag_backend != "tpu":
             raise ValueError(
@@ -527,6 +528,17 @@ class PrimaryNode:
             # process-shared VerifyService makes this a deliberate no-op
             # (other co-hosted nodes keep using it).
             await self.crypto_pool.close()
+        if self._dag_backend == "tpu":
+            # Bounded-join this node's background window prewarm compiles
+            # (off-loop: the join blocks). A prewarm thread that outlived
+            # its node contends with the successor's foreground traces for
+            # XLA's compiler locks — the PR-1 stabilization failure mode,
+            # previously handled only at interpreter exit.
+            from .tpu.dag_kernels import join_prewarm_threads
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: join_prewarm_threads(30.0)
+            )
         self.storage.close()
 
 

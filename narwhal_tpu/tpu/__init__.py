@@ -8,6 +8,16 @@ JAX_COMPILATION_CACHE_DIR) matters because the big kernels — the per-item
 ed25519 Straus walk, the batch MSM accumulate, the chain_commit scan —
 take minutes to compile uncached on slow hosts/tunnels, and every process
 (node, bench, pytest) should pay that once per machine, not once per run.
+
+CPU targets are cache-DISABLED by default (r5: XLA:CPU AOT entries encode
+compile-machine pseudo-features the loader has crashed on), EXCEPT when
+the operator explicitly opts in with NARWHAL_JAX_CACHE_DIR — the
+multichip sweep's knob: an 8-virtual-device CPU mesh pays minutes-long
+sharded kernel compiles, and the opt-in cache makes every process after
+the first deserialize them instead (measured safe round-trip on this
+container; see README "Multi-chip device plane"). The opt-in stays
+per-platform-subdirectoried so a cpu entry can never poison a real
+chip's cache dir.
 """
 
 from __future__ import annotations
@@ -22,7 +32,11 @@ def enable_compilation_cache() -> None:
     global _cache_enabled
     if _cache_enabled:
         return
-    cache_dir = os.environ.get(
+    # NARWHAL_JAX_CACHE_DIR: explicit operator opt-in — enables the
+    # persistent cache even for CPU-target processes (virtual-device
+    # meshes), where the default below refuses. Empty value = unset.
+    opt_in_dir = os.environ.get("NARWHAL_JAX_CACHE_DIR", "").strip()
+    cache_dir = opt_in_dir or os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache"
@@ -39,27 +53,33 @@ def enable_compilation_cache() -> None:
             platform = jax.default_backend()
         except Exception:
             platform = "unknown"
-        # Never persist CPU-target executables: XLA:CPU AOT entries encode
-        # compile-machine pseudo-features (+prefer-no-scatter, ...) that
-        # the loader rejects or CRASHES on — entries written by a process
-        # on THIS host SIGSEGV'd the next suite run inside
-        # compilation_cache.get_executable_and_time. The cache's purpose
-        # is the real chip's minutes-long tunnel compiles; CPU-backend
-        # runs (tests, dry runs) rely on in-process caching only. A
-        # process counts as CPU-target when the default backend is cpu,
-        # JAX_PLATFORMS forces cpu, or jax_default_device is pinned to a
-        # cpu device (the conftest/dryrun configurations — their default
-        # backend can still be the accelerator plugin, which would
-        # otherwise mix poisonous cpu entries into the chip's cache dir).
+        # Never persist CPU-target executables BY DEFAULT: XLA:CPU AOT
+        # entries encode compile-machine pseudo-features
+        # (+prefer-no-scatter, ...) that the loader rejects or CRASHES on
+        # — entries written by a process on THIS host SIGSEGV'd the next
+        # suite run inside compilation_cache.get_executable_and_time. The
+        # cache's purpose is the real chip's minutes-long tunnel compiles;
+        # CPU-backend runs (tests, dry runs) rely on in-process caching
+        # only — unless NARWHAL_JAX_CACHE_DIR explicitly opts in (the
+        # multichip sweep, where the sharded compiles dominate and the
+        # round-trip is re-verified by the sweep itself). A process counts
+        # as CPU-target when the default backend is cpu, JAX_PLATFORMS
+        # forces cpu, or jax_default_device is pinned to a cpu device (the
+        # conftest/dryrun configurations — their default backend can still
+        # be the accelerator plugin, which would otherwise mix poisonous
+        # cpu entries into the chip's cache dir).
         forced = os.environ.get("JAX_PLATFORMS", "").strip().lower()
         pinned = getattr(jax.config, "jax_default_device", None)
-        if (
+        cpu_target = (
             platform == "cpu"
             or forced.startswith("cpu")
             or (pinned is not None and getattr(pinned, "platform", "") == "cpu")
-        ):
+        )
+        if cpu_target and not opt_in_dir:
             _cache_enabled = True
             return
+        if cpu_target:
+            platform = "cpu"  # opt-in: keep cpu entries in their own subdir
         cache_dir = os.path.join(cache_dir, platform)
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 
 from . import enable_compilation_cache
+from . import kernel_registry
 
 enable_compilation_cache()
 
@@ -44,7 +45,7 @@ from ..types import Certificate, ConsensusOutput, Digest, Round, SequenceNumber
 from ..consensus.state import ConsensusState
 
 
-@jax.jit
+@kernel_registry.tracked_jit
 def reach_mask(parent, uncommitted, start_off, start_onehot):
     """Reachability mask [W, N]: certificates reachable from the start
     certificate by walking parent links down the window, propagating only
@@ -75,11 +76,14 @@ def reach_mask(parent, uncommitted, start_off, start_onehot):
     return rows[::-1]  # [W, N] bool, row w = offset w
 
 
-@jax.jit
+@kernel_registry.tracked_jit(donate_argnums=(0, 1))
 def roll_window(parent, present, shift):
     """Slide the device-resident window by `shift` rounds: drop the oldest
     `shift` rows and zero the vacated tail. One on-device shuffle instead of
-    a full [W, N, N] host->device re-upload when GC advances the base."""
+    a full [W, N, N] host->device re-upload when GC advances the base.
+    The window tensors are donated: the previous generation is dead the
+    moment the roll dispatches, so XLA reuses its buffers instead of
+    holding two [W, N, N] copies live."""
     W = present.shape[0]
     rows = jnp.arange(W, dtype=jnp.int32)
     keep = rows < (W - shift)
@@ -88,12 +92,13 @@ def roll_window(parent, present, shift):
     return parent, present
 
 
-@jax.jit
+@kernel_registry.tracked_jit(donate_argnums=(0, 1))
 def place_batch(parent, present, offs, idxs, rows, valid):
     """Scatter a batch of certificate placements into the device-resident
     window: for each valid slot t, present[offs[t], idxs[t]] = 1 and
     parent[offs[t], idxs[t], :] = rows[t]. Padded slots (valid=0) are
-    no-ops, so power-of-two padded batches reuse one compilation per size."""
+    no-ops, so power-of-two padded batches reuse one compilation per size.
+    Donates the window tensors (see roll_window)."""
 
     def body(carry, inp):
         parent, present = carry
@@ -111,7 +116,7 @@ def place_batch(parent, present, offs, idxs, rows, valid):
     return parent, present
 
 
-@jax.jit
+@kernel_registry.tracked_jit
 def leader_support(parent, present, stakes, support_off, leader_idx):
     """Stake carried by certificates at `support_off` linking to the leader at
     the round below (bullshark.rs:66-76 / tusk.rs:66-74)."""
@@ -120,7 +125,7 @@ def leader_support(parent, present, stakes, support_off, leader_idx):
     return jnp.sum(jnp.where(voters, stakes, 0))
 
 
-@jax.jit
+@kernel_registry.tracked_jit
 def chain_commit(parent, present, gc_depth, lc_rel, lcr_rel, offs, onehots):
     """One fused dispatch per commit event: the full chain flatten — a
     lax.scan over the chain's leaders (oldest first), each step computing
@@ -179,14 +184,14 @@ def _prune_prewarm_threads() -> None:
     _PREWARM_THREADS[:] = [t for t in _PREWARM_THREADS if t.is_alive()]
 
 
-def _join_prewarm_threads() -> None:
+def _join_prewarm_threads(grace: float = 60.0) -> None:
     # Bounded join: waiting forever would make a hung tunneled device (stuck
     # mid-compile in XLA C++) block process exit outright. 60 s is enough
     # for any cache-served compile; a thread still alive after that is
     # logged and abandoned — a daemon thread, so it cannot keep the
     # interpreter alive, and the abort-on-finalization hazard the join
     # exists to avoid is already vanishingly rare at that point.
-    deadline = time.monotonic() + 60.0
+    deadline = time.monotonic() + grace
     for t in list(_PREWARM_THREADS):
         t.join(timeout=max(0.0, deadline - time.monotonic()))
         if t.is_alive():
@@ -196,6 +201,15 @@ def _join_prewarm_threads() -> None:
                 t.name,
             )
     _prune_prewarm_threads()
+
+
+def join_prewarm_threads(grace: float = 60.0) -> None:
+    """Bounded-join every in-flight background window compile. Called from
+    `PrimaryNode.shutdown` (off-loop) so a node's prewarm threads cannot
+    outlive it and contend with a successor's foreground traces for XLA's
+    compiler locks — the PR-1 stabilization failure mode, previously
+    handled only by the atexit hook (process exit), not node teardown."""
+    _join_prewarm_threads(grace)
 
 
 class DagWindow:
@@ -401,6 +415,7 @@ class TpuBullshark:
             device_resident=(mesh is None),
         )
         self._chain_commit = self._build_dispatch()
+        self._dispatch_W = self.win.W
         if prewarm is None:
             # Default only — an explicit prewarm=True/False always wins.
             # Background compiles contend with foreground jit traces for
@@ -478,31 +493,30 @@ class TpuBullshark:
         return -(-committee.size() // auth) * auth
 
     def _build_dispatch(self):
-        """The chain_commit entry point: the module-level jit on a single
-        device, or a mesh-sharded jit when a mesh is configured. Scalars and
-        the small per-leader operands are replicated (NamedSharding with an
-        empty spec) so no operand ever falls back to the default backend's
-        device placement."""
+        """The chain_commit entry point: the module-level tracked kernel on
+        a single device, or the REGISTRY's mesh-sharded wrapper when a mesh
+        is configured — one jit per (chain_commit, mesh shape) process-wide,
+        so N co-hosted engines (and every window regrowth) share one
+        compiled program per W instead of re-jitting. Scalars and the small
+        per-leader operands are replicated (empty PartitionSpec) so no
+        operand ever falls back to the default backend's device placement."""
         if self.mesh is None:
             return chain_commit
-        from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        def s(*spec):
-            return NamedSharding(self.mesh, P(*spec))
-
-        return jax.jit(
+        return kernel_registry.sharded(
             chain_commit,
-            in_shardings=(
-                s(None, None, "auth"),  # parent [W, N, N]: link axis
-                s(None, "auth"),  # present [W, N]
-                s(),  # gc_depth scalar
-                s("auth"),  # lc_rel [N]
-                s(),  # lcr_rel scalar
-                s(),  # offs [K]
-                s(None, None),  # onehots [K, N]
+            self.mesh,
+            in_specs=(
+                P(None, None, "auth"),  # parent [W, N, N]: link axis
+                P(None, "auth"),  # present [W, N]
+                None,  # gc_depth scalar
+                P("auth"),  # lc_rel [N]
+                None,  # lcr_rel scalar
+                None,  # offs [K]
+                P(None, None),  # onehots [K, N]
             ),
-            out_shardings=s(None, None, "auth"),
+            out_specs=P(None, None, "auth"),
         )
 
     def recover(self, state: ConsensusState) -> None:
@@ -601,21 +615,35 @@ class TpuBullshark:
             return None
         return r, round
 
-    def _ingest_and_dispatch(self, state: ConsensusState, certificate: Certificate):
-        """Shared pre-readback half of process_certificate: record the
-        certificate, evaluate the commit rule on the host mirror, and — when
-        this certificate commits a leader — dispatch the fused chain walk.
-        Returns (device masks, chain length) or None."""
-        round = certificate.round
+    def _ingest(self, state: ConsensusState, certificate: Certificate) -> None:
+        """Record one certificate in the host mirror + window (no dispatch)."""
         state.add(certificate)  # host mirror for recovery parity
         keep_floor = max(0, state.last_committed_round - self.gc_depth)
         if not self.win.insert(certificate, keep_floor):
             raise RuntimeError(
-                f"round {round} outside DAG window (base {self.win.round_base}, W {self.win.W})"
+                f"round {certificate.round} outside DAG window "
+                f"(base {self.win.round_base}, W {self.win.W})"
             )
+
+    def _refresh_dispatch(self) -> None:
+        if self.win.W != self._dispatch_W:
+            # The window grew (or slid through a regrow): re-derive the
+            # dispatch from the kernel registry instead of trusting the
+            # wrapper captured at construction. Same mesh -> the registry
+            # returns the same process-wide sharded program, so a meshed
+            # engine keeps its 'auth'-partitioned layouts across growth
+            # rather than silently re-tracing an unsharded (replicated)
+            # kernel; tests/test_dag_kernels.py pins the invariant.
+            self._chain_commit = self._build_dispatch()
+            self._dispatch_W = self.win.W
         if self._prewarm_enabled:
             # Keep one doubling ahead of the current window size.
             self._prewarm(self.win.W * 2)
+
+    def _eval_commit(self, state: ConsensusState, round: Round):
+        """Evaluate the commit rule for a round-`round` certificate against
+        SETTLED state and dispatch the fused chain walk when it commits.
+        Returns (device masks, chain length) or None."""
         coords = self._commit_coords(round)
         if coords is None:
             return None
@@ -626,6 +654,93 @@ class TpuBullshark:
         if leader_idx is None:
             return None
         return self._dispatch_commit(state, leader_round, support_round, leader_idx)
+
+    def _ingest_and_dispatch(self, state: ConsensusState, certificate: Certificate):
+        """Shared pre-readback half of process_certificate: record the
+        certificate, evaluate the commit rule on the host mirror, and — when
+        this certificate commits a leader — dispatch the fused chain walk.
+        Returns (device masks, chain length) or None."""
+        self._ingest(state, certificate)
+        self._refresh_dispatch()
+        return self._eval_commit(state, certificate.round)
+
+    def process_batch(
+        self,
+        state: ConsensusState,
+        consensus_index: SequenceNumber,
+        certificates: list[Certificate],
+    ) -> list[ConsensusOutput]:
+        """Batched process_certificate: all inserts land as ONE device
+        scatter (the window syncs once, at the first commit dispatch), the
+        commit rule is then evaluated per trigger in arrival order, and
+        each commit event's mask readback is deferred one event so it
+        overlaps the next event's host bookkeeping.
+
+        The output sequence is IDENTICAL to per-certificate calls on the
+        same (causally ordered) stream: Bullshark/Tusk re-evaluate the
+        commit rule on every support-round certificate, a leader's reach
+        mask covers only rounds at or below it, and chain linkage walks
+        the LEADER's ancestry (present before the leader under causal
+        delivery) — so batching arrivals can move where a commit is
+        yielded, never its content or order. Each event still materializes
+        before the next event's rule evaluation: last_committed gates both
+        the rule and the GC filter."""
+        for cert in certificates:
+            self._ingest(state, cert)
+        self._refresh_dispatch()
+        outputs: list[ConsensusOutput] = []
+        pending = None
+        for cert in certificates:
+            if self._commit_coords(cert.round) is None:
+                continue
+            if pending is not None:
+                masks_dev, K = pending
+                outs = self._materialize(
+                    state, consensus_index, np.asarray(masks_dev), K
+                )
+                consensus_index += len(outs)
+                outputs.extend(outs)
+            pending = self._eval_commit(state, cert.round)
+        if pending is not None:
+            masks_dev, K = pending
+            outputs.extend(
+                self._materialize(state, consensus_index, np.asarray(masks_dev), K)
+            )
+        return outputs
+
+    async def process_batch_async(
+        self,
+        state: ConsensusState,
+        consensus_index: SequenceNumber,
+        certificates: list[Certificate],
+    ) -> list[ConsensusOutput]:
+        """process_batch with each deferred readback awaited off-thread —
+        the Consensus runner's greedy-drain path, so a certificate burst
+        costs one batched insert and the loop keeps serving RPC during
+        every device->host round trip."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        for cert in certificates:
+            self._ingest(state, cert)
+        self._refresh_dispatch()
+        outputs: list[ConsensusOutput] = []
+        pending = None
+        for cert in certificates:
+            if self._commit_coords(cert.round) is None:
+                continue
+            if pending is not None:
+                masks_dev, K = pending
+                masks = await loop.run_in_executor(None, np.asarray, masks_dev)
+                outs = self._materialize(state, consensus_index, masks, K)
+                consensus_index += len(outs)
+                outputs.extend(outs)
+            pending = self._eval_commit(state, cert.round)
+        if pending is not None:
+            masks_dev, K = pending
+            masks = await loop.run_in_executor(None, np.asarray, masks_dev)
+            outputs.extend(self._materialize(state, consensus_index, masks, K))
+        return outputs
 
     def _dispatch_commit(self, state, r, support_round, leader_idx):
         """Quorum pre-check + chain detection on the host mirror (cheap

@@ -35,8 +35,6 @@ The host wrapper lives in narwhal_tpu/tpu/verifier.py.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
 import jax
@@ -45,6 +43,7 @@ from jax import lax
 
 from . import enable_compilation_cache
 from . import ed25519_ref as ref
+from .kernel_registry import tracked_jit
 
 enable_compilation_cache()
 
@@ -393,7 +392,7 @@ def _select_const(table, digit):
     return cur[0]
 
 
-@jax.jit
+@tracked_jit
 def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
     """Per-lane check of [S]B + [k](−A) against R, under BOTH rules:
 
@@ -457,6 +456,108 @@ def verify_batch_kernel(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
         diff = pt_double(diff)
     ok_cof = fe_eq(diff[0], jnp.zeros_like(diff[0])) & fe_eq(diff[1], diff[2])
     return ok_strict, ok_cof & valid & r_valid
+
+
+# ---------------------------------------------------------------------------
+# Staged per-item verification: the monolithic trace split into three
+# dispatchable stages. The monolith above compiles as ONE XLA module whose
+# graph holds ~3.5 exponentiation-ladder instances (A decompress, R
+# decompress, the final fe_invert) plus the 64-window scan — minutes of
+# single-core LLVM per (kernel, mesh shape), the MULTICHIP_r05 rc=124
+# bill. The staged pipeline compiles three bounded modules instead:
+#
+#   decompress (ONE ladder, dispatched twice: A then R — one compile
+#   serves both point sets, and the msm pipeline reuses the same stage)
+#   -> straus scan (table build + 64-window walk)
+#   -> verdict (fe_invert ladder + strict/cofactored epilogue)
+#
+# Intermediates stay on device between stages (stacked [4, NLIMB, B]
+# coordinate tensors, donated forward so XLA reuses the buffers); the
+# per-lane arithmetic is IDENTICAL to the monolith — decompress, the scan
+# body and the epilogue are the same functions, batched the same way — so
+# verdicts are bit-equal (pinned by tests/test_multichip.py). The mesh-
+# sharded verifier dispatches these; the single-chip path keeps the
+# monolith (one dispatch per bucket matters through a high-RTT link).
+# ---------------------------------------------------------------------------
+
+
+@tracked_jit
+def verify_decompress_kernel(y_rows, signs):
+    """Stage 1: decompress one point set. y_rows int[B, NLIMB] canonical y
+    limbs (host layout), signs int[B]. Returns (points int32[4, NLIMB, B]
+    extended coords, valid bool[B]). Dispatched once for the A set and
+    once for the R set — same shape, one compile."""
+    y = y_rows.T.astype(jnp.int32)
+    point, valid = decompress(y, signs.astype(jnp.int32))
+    return jnp.stack(point, axis=0), valid
+
+
+@tracked_jit
+def verify_straus_kernel(a_pt, k_digits, s_digits):
+    """Stage 2: the shared-doubling Straus walk. a_pt int32[4, NLIMB, B]
+    decompressed A points; k_digits/s_digits int[B, 64] 4-bit MSB-first.
+    Returns acc int32[4, NLIMB, B] = [S]B + [k](-A), projective."""
+    a_point = tuple(a_pt[i] for i in range(4))
+    k_digits = k_digits.T.astype(jnp.int32)
+    s_digits = s_digits.T.astype(jnp.int32)
+    B = a_pt.shape[2]
+
+    table_a = _pt_cached_table(pt_neg(a_point), B)
+    ident = pt_identity((B,))
+
+    def step(acc, digits):
+        kd, sd = digits
+        for _ in range(4):
+            acc = pt_double(acc)
+        qa = tuple(_select(table_a[i], kd) for i in range(4))
+        acc = pt_add_cached(acc, qa)
+        qb = (
+            _select_const(_BT[:, 0], sd),
+            _select_const(_BT[:, 1], sd),
+            _select_const(_BT[:, 2], sd),
+        )
+        acc = pt_add_cached_z1(acc, qb)
+        return acc, None
+
+    acc, _ = lax.scan(step, ident, (k_digits, s_digits))
+    return jnp.stack(acc, axis=0)
+
+
+@tracked_jit
+def verify_verdict_kernel(acc_pt, r_pt, r_y, r_sign, a_valid, r_valid):
+    """Stage 3: both verdicts from the scan accumulator and the
+    decompressed R set — the monolith's epilogue verbatim. Returns
+    (strict bool[B], cofactored bool[B])."""
+    acc = tuple(acc_pt[i] for i in range(4))
+    r_y_lm = r_y.T.astype(jnp.int32)
+    r_sign = r_sign.astype(jnp.int32)
+
+    zinv = fe_invert(acc[2])
+    x = fe_mul(acc[0], zinv)
+    y = fe_mul(acc[1], zinv)
+    x_can = fe_canonical(x)
+    ok_strict = fe_eq(y, r_y_lm) & ((x_can[0] & 1) == r_sign) & a_valid
+
+    diff = pt_add(acc, pt_neg(tuple(r_pt[i] for i in range(4))))
+    for _ in range(3):
+        diff = pt_double(diff)
+    ok_cof = fe_eq(diff[0], jnp.zeros_like(diff[0])) & fe_eq(diff[1], diff[2])
+    return ok_strict, ok_cof & a_valid & r_valid
+
+
+@tracked_jit(static_argnames=("chunk",))
+def msm_window_kernel(pts, digits, chunk=128):
+    """Staged msm stage 2: cached-table build from -P plus the window-lane
+    accumulate over ONE point set (the monolith fused A and R into a
+    single concatenated trace). pts int32[4, NLIMB, B] decompressed
+    points, digits int[B, W]. Returns V int32[4, NLIMB, W] loose limbs per
+    window lane. Under mesh sharding the batch axis is partitioned and V
+    (no batch axis left) comes back replicated: per-device partial
+    accumulates with one XLA-inserted cross-device reduce."""
+    point = tuple(pts[i] for i in range(4))
+    table = _pt_cached_table(pt_neg(point), pts.shape[2])
+    v = _accumulate_windows(table, digits.astype(jnp.int32), chunk)
+    return jnp.stack(v, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -561,7 +662,7 @@ def _accumulate_windows(table, digits, chunk):
     return tuple(a[..., 0] for a in acc)  # [NLIMB, W]
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
+@tracked_jit(static_argnames=("chunk",))
 def msm_accumulate_kernel(a_y, a_sign, r_y, r_sign, ak_digits, z_digits, chunk=128):
     """Device half of the batch check Σ [z_ik_i](−A_i) + Σ [z_i](−R_i):
     per-window point sums over the whole batch.

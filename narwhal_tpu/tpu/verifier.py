@@ -54,47 +54,68 @@ def _next_pow2(n: int) -> int:
     return b
 
 
-# Sharded-kernel jit wrappers shared across TpuVerifier instances keyed by
-# the mesh geometry. Each `jax.jit(...)` call owns its OWN trace/compile
-# cache, so two verifiers over the same mesh (e.g. the dryrun's item-mode
-# and msm-mode legs: the msm verifier re-jits the per-item kernel for its
-# fallback path) would otherwise each pay the multi-minute
-# jit_verify_batch_kernel compile — the MULTICHIP_r05 rc=124 bill.
-_SHARDED_KERNELS: dict = {}
-
-
 def _sharded_kernels(kernel, mesh, data_axis: str):
-    key = (
-        tuple(mesh.devices.flat),
-        tuple(mesh.axis_names),
-        tuple(mesh.devices.shape),
-        data_axis,
-    )
-    cached = _SHARDED_KERNELS.get(key)
-    if cached is not None:
-        return cached
-    import jax
-    from jax.sharding import NamedSharding
+    """The mesh-sharded verify pipeline: STAGED kernels from the process-
+    wide registry (kernel_registry.sharded — one compile per (kernel, mesh
+    shape) no matter how many verifiers/modes share the mesh).
+
+    The monolithic verify_batch_kernel/msm_accumulate_kernel traces compile
+    as single multi-minute XLA modules (the MULTICHIP_r05 rc=124 bill);
+    the sharded variant dispatches the split stages instead —
+    ed25519.verify_decompress_kernel (ONE ladder compile serving the A set,
+    the R set, AND both msm point sets), verify_straus_kernel,
+    verify_verdict_kernel, msm_window_kernel — with intermediates resident
+    on device between stages and donated forward. Per-lane arithmetic is
+    identical to the monoliths, so verdicts are bit-equal.
+
+    Returns (item_fn, msm_fn) with the monoliths' host-facing signatures.
+    """
     from jax.sharding import PartitionSpec as P
 
-    def s(*spec):
-        return NamedSharding(mesh, P(*spec))
+    from . import kernel_registry
 
-    b1, b2 = s(data_axis), s(data_axis, None)
-    item_kernel = jax.jit(
-        kernel.verify_batch_kernel.__wrapped__,
-        in_shardings=(b2, b1, b2, b1, b2, b2),
-        out_shardings=(b1, b1),
+    b = P(data_axis)  # [B]
+    bn = P(data_axis, None)  # [B, NLIMB] / [B, W] host-layout rows
+    cnb = P(None, None, data_axis)  # [4, NLIMB, B] coord stacks
+
+    decompress = kernel_registry.sharded(
+        kernel.verify_decompress_kernel, mesh,
+        in_specs=(bn, b), out_specs=(cnb, b),
     )
-    msm_kernel = jax.jit(
-        kernel.msm_accumulate_kernel.__wrapped__,
+    straus = kernel_registry.sharded(
+        kernel.verify_straus_kernel, mesh,
+        in_specs=(cnb, bn, bn), out_specs=cnb,
+        donate_argnums=(0,),
+    )
+    # No donation on verdict/msm_window: their outputs are far smaller
+    # than the coordinate-stack inputs, so nothing could alias and jax
+    # would warn 'donated buffers were not usable' on every compile.
+    verdict = kernel_registry.sharded(
+        kernel.verify_verdict_kernel, mesh,
+        in_specs=(cnb, cnb, bn, b, b, b), out_specs=(b, b),
+    )
+    msm_window = kernel_registry.sharded(
+        kernel.msm_window_kernel, mesh,
+        # V [4, NLIMB, W] has no batch axis left: per-device partial
+        # accumulates, one XLA-inserted cross-device reduce (replicated).
+        in_specs=(cnb, bn), out_specs=None,
         static_argnames=("chunk",),
-        in_shardings=(b2, b1, b2, b1, b2, b2),
-        # V_a/V_r replicated (cross-device reduced), valid sharded.
-        out_shardings=(s(), s(), b1),
     )
-    _SHARDED_KERNELS[key] = (item_kernel, msm_kernel)
-    return item_kernel, msm_kernel
+
+    def item_fn(a_y, a_sign, r_y, r_sign, k_digits, s_digits):
+        a_pt, a_valid = decompress(a_y, a_sign)
+        r_pt, r_valid = decompress(r_y, r_sign)
+        acc = straus(a_pt, k_digits, s_digits)
+        return verdict(acc, r_pt, r_y, r_sign, a_valid, r_valid)
+
+    def msm_fn(a_y, a_sign, r_y, r_sign, ak_digits, z_digits):
+        a_pt, a_valid = decompress(a_y, a_sign)
+        r_pt, r_valid = decompress(r_y, r_sign)
+        v_a = msm_window(a_pt, ak_digits)
+        v_r = msm_window(r_pt, z_digits)
+        return v_a, v_r, a_valid & r_valid
+
+    return item_fn, msm_fn
 
 
 def msm_epilogue_check(

@@ -1,0 +1,21 @@
+"""no-untracked-jit clean fixture: every jit entry point routes through
+the shared kernel registry."""
+
+from narwhal_tpu.tpu import kernel_registry
+from narwhal_tpu.tpu.kernel_registry import tracked_jit
+
+
+@tracked_jit
+def kernel_a(x):
+    return x + 1
+
+
+@kernel_registry.tracked_jit(static_argnames=("n",))
+def kernel_b(x, n=2):
+    return x * n
+
+
+def sharded_variant(mesh, spec):
+    return kernel_registry.sharded(
+        kernel_a, mesh, in_specs=(spec,), out_specs=spec
+    )

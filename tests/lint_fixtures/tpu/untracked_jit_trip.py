@@ -1,0 +1,23 @@
+"""no-untracked-jit tripping fixture: raw jits in tpu/ outside the
+registry — a decorator, a partial-decorator, and a wrapping call."""
+
+import functools
+
+import jax
+
+
+@jax.jit  # finding 1: raw decorator
+def kernel_a(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("n",))  # finding 2: partial form
+def kernel_b(x, n=2):
+    return x * n
+
+
+def kernel_c(x):
+    return x - 1
+
+
+kernel_c_jit = jax.jit(kernel_c)  # finding 3: wrapping call

@@ -245,3 +245,119 @@ def test_window_growth_is_precompiled():
     # parallel load on a 1-core CI host.
     assert time.monotonic() - t0 < 30.0, "post-growth dispatch stalled"
 
+
+def test_process_batch_matches_sequential():
+    """The fused pipeline's engine half: feeding a causally ordered stream
+    through process_batch (arbitrary chunking) yields the IDENTICAL output
+    sequence to per-certificate calls — content, order and consensus
+    indexes — including windows with losses and multi-leader chains."""
+    f = CommitteeFixture(size=4)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_certificates(
+        f.committee, 1, 25, genesis,
+        failure_probability=0.2, rng=random.Random(4),
+    )
+    seq_state = ConsensusState(Certificate.genesis(f.committee))
+    bat_state = ConsensusState(Certificate.genesis(f.committee))
+    seq_eng = TpuBullshark(f.committee, NodeStorage(None).consensus_store, GC,
+                           leader_fn=fixed_leader)
+    bat_eng = TpuBullshark(f.committee, NodeStorage(None).consensus_store, GC,
+                           leader_fn=fixed_leader)
+    seq_out = []
+    i = 0
+    for c in certs:
+        outs = seq_eng.process_certificate(seq_state, i, c)
+        i += len(outs)
+        seq_out.extend(outs)
+    bat_out = []
+    j = 0
+    for lo in range(0, len(certs), 7):  # chunking unaligned with rounds
+        outs = bat_eng.process_batch(bat_state, j, certs[lo:lo + 7])
+        j += len(outs)
+        bat_out.extend(outs)
+    assert [o.certificate.digest for o in seq_out] == [
+        o.certificate.digest for o in bat_out
+    ]
+    assert [o.consensus_index for o in seq_out] == [
+        o.consensus_index for o in bat_out
+    ]
+    assert seq_state.last_committed == bat_state.last_committed
+    assert len(seq_out) > 10
+
+
+def test_process_batch_async_matches_sequential(run):
+    """The runner's burst path (process_batch_async) is output-identical."""
+    f = CommitteeFixture(size=4)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    certs, _ = make_certificates(
+        f.committee, 1, 12, genesis, failure_probability=0.0,
+        rng=random.Random(0),
+    )
+    seq_state = ConsensusState(Certificate.genesis(f.committee))
+    bat_state = ConsensusState(Certificate.genesis(f.committee))
+    seq_eng = TpuBullshark(f.committee, None, GC, leader_fn=fixed_leader)
+    bat_eng = TpuBullshark(f.committee, None, GC, leader_fn=fixed_leader)
+    seq_out = []
+    i = 0
+    for c in certs:
+        outs = seq_eng.process_certificate(seq_state, i, c)
+        i += len(outs)
+        seq_out.extend(outs)
+
+    async def batched():
+        return await bat_eng.process_batch_async(bat_state, 0, list(certs))
+
+    bat_out = run(batched(), timeout=120.0)
+    assert [o.certificate.digest for o in seq_out] == [
+        o.certificate.digest for o in bat_out
+    ]
+
+
+def test_mesh_growth_rederives_sharded_dispatch():
+    """ISSUE 10 satellite: after _grow() doubles W, a MESHED engine must
+    re-derive its dispatch from the kernel registry — the same process-
+    wide 'auth'-sharded program — rather than a fresh unsharded jit that
+    would silently run replicated layouts."""
+    from narwhal_tpu.tpu import kernel_registry
+    from narwhal_tpu.tpu.dag_kernels import chain_commit
+
+    mesh = _auth_mesh(2)
+    f = CommitteeFixture(size=4)
+    genesis = {c.digest for c in Certificate.genesis(f.committee)}
+    keys = f.committee.authority_keys()[1:]  # no leader => growth, not slide
+    certs, _ = make_certificates(f.committee, 1, 40, genesis, keys=keys)
+    state = ConsensusState(Certificate.genesis(f.committee))
+    dev = TpuBullshark(f.committee, None, gc_depth=10, leader_fn=fixed_leader,
+                       window=16, mesh=mesh)
+    before = dev._chain_commit
+    for c in certs:
+        assert dev.process_certificate(state, 0, c) == []
+    assert dev.win.W >= 40  # grew (twice)
+    assert dev._dispatch_W == dev.win.W
+    # Still the registry's sharded wrapper for THIS mesh — not a fresh
+    # unsharded trace, and not a stale per-shape object.
+    from jax.sharding import PartitionSpec as P
+
+    expected = kernel_registry.sharded(
+        chain_commit, mesh,
+        in_specs=(
+            P(None, None, "auth"), P(None, "auth"), None, P("auth"),
+            None, None, P(None, None),
+        ),
+        out_specs=P(None, None, "auth"),
+    )
+    assert dev._chain_commit is expected
+    assert expected is before  # same mesh -> same program across growth
+    assert dev._chain_commit is not chain_commit
+    # And the grown window still commits correctly through the mesh.
+    from narwhal_tpu.fixtures import mock_certificate
+
+    lead = mock_certificate(f.committee, f.committee.authority_keys()[0], 40, set())
+    assert dev.process_certificate(state, 0, lead) == []
+    outs = []
+    for sup_key in f.committee.authority_keys()[1:3]:  # f+1 = 2 supporters
+        sup = mock_certificate(f.committee, sup_key, 41, {lead.digest})
+        outs = dev.process_certificate(state, 0, sup)
+        if outs:
+            break
+    assert outs and outs[-1].certificate.digest == lead.digest

@@ -93,6 +93,7 @@ EXPECTED_RULES = {
     "no-per-item-rpc-in-loop",
     "no-unbounded-channel",
     "no-wall-clock-in-actors",
+    "no-untracked-jit",
 }
 
 FIXTURE_FOR = {
@@ -123,6 +124,10 @@ FIXTURE_FOR = {
     "no-wall-clock-in-actors": (
         "primary/wall_clock_trip.py",
         "primary/wall_clock_clean.py",
+    ),
+    "no-untracked-jit": (
+        "tpu/untracked_jit_trip.py",
+        "tpu/untracked_jit_clean.py",
     ),
 }
 
@@ -166,6 +171,8 @@ def test_fixture_finding_counts():
         "no-unbounded-channel": 3,  # bare, keyword-only gauge, attr form
         # time.time, time.monotonic, aliased import, loop var, chained call
         "no-wall-clock-in-actors": 5,
+        # raw @jax.jit decorator, partial(jax.jit, ...) form, jax.jit(f) call
+        "no-untracked-jit": 3,
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

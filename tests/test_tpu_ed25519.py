@@ -478,3 +478,60 @@ def test_group_chunk_bisect_keeps_honest_groups_off_host(monkeypatch):
     # Exactly ONE host walk: the attacker's own group.
     assert len(host_calls) == 1
     assert host_calls[0] == groups[3][2]
+
+
+@_kernel_dispatch
+def test_staged_kernels_match_monolith():
+    """The mesh path's STAGED kernels (decompress -> straus -> verdict;
+    msm_window) must be BIT-equal to the monolithic traces they split —
+    raw strict/cofactored lanes and raw msm window accumulators, not just
+    verdicts — on a batch mixing valid, forged and corrupt rows. Run on a
+    1-device data mesh so only the staging differs, never the sharding."""
+    from narwhal_tpu.tpu.verifier import _sharded_kernels, data_mesh
+
+    rng = np.random.default_rng(7)
+    keys = [KeyPair.generate() for _ in range(4)]
+    items = []
+    for i in range(16):
+        kp = keys[i % len(keys)]
+        msg = bytes([i]) * (1 + i % 9)
+        sig = kp.sign(msg)
+        if i % 5 == 1:
+            sig = sig[:32] + bytes(32)  # garbage S (canonical, wrong)
+        elif i % 5 == 3:
+            msg = msg + b"!"  # wrong message
+        items.append((kp.public, msg, sig))
+
+    # Pack exactly as TpuVerifier.submit does (all rows pass precheck).
+    v = TpuVerifier(max_bucket=16)
+    precheck, a_all, r_all, s_all, k_all = v._precheck_py(items)
+    assert precheck.all()
+    a_y = k.bytes_to_limbs(a_all).astype(np.int16)
+    r_y = k.bytes_to_limbs(r_all).astype(np.int16)
+    a_sign = (a_all[:, 31] >> 7).astype(np.int8)
+    r_sign = (r_all[:, 31] >> 7).astype(np.int8)
+    k_digits = k.bytes_to_digits(k_all).astype(np.int8)
+    s_digits = k.bytes_to_digits(s_all).astype(np.int8)
+
+    item_fn, msm_fn = _sharded_kernels(k, data_mesh(1), "data")
+
+    mono_strict, mono_cof = k.verify_batch_kernel(
+        a_y, a_sign, r_y, r_sign, k_digits, s_digits
+    )
+    st_strict, st_cof = item_fn(a_y, a_sign, r_y, r_sign, k_digits, s_digits)
+    assert np.array_equal(np.asarray(mono_strict), np.asarray(st_strict))
+    assert np.array_equal(np.asarray(mono_cof), np.asarray(st_cof))
+    assert np.asarray(mono_strict).sum() > 0  # batch had valid rows
+    assert not np.asarray(mono_strict).all()  # ... and invalid ones
+
+    ak_digits = rng.integers(0, 16, (16, 64)).astype(np.int8)
+    z_digits = rng.integers(0, 16, (16, 32)).astype(np.int8)
+    mono_va, mono_vr, mono_valid = k.msm_accumulate_kernel(
+        a_y, a_sign, r_y, r_sign, ak_digits, z_digits
+    )
+    st_va, st_vr, st_valid = msm_fn(
+        a_y, a_sign, r_y, r_sign, ak_digits, z_digits
+    )
+    assert np.array_equal(np.asarray(mono_va), np.asarray(st_va))
+    assert np.array_equal(np.asarray(mono_vr), np.asarray(st_vr))
+    assert np.array_equal(np.asarray(mono_valid), np.asarray(st_valid))

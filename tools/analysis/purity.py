@@ -34,7 +34,18 @@ _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([A-Za-z0-9_\-*,\s]+)\)")
 
 _IMPURE_MODULES = {"time", "random"}
 _IMPURE_CALLS = {"print", "input"}
-_JIT_NAMES = {"jax.jit", "jit"}
+_JIT_NAMES = {
+    "jax.jit",
+    "jit",
+    # The shared kernel registry's wrappers (tpu/kernel_registry.py, the
+    # no-untracked-jit idiom): tracked_jit decoratees and sharded(fn, ...)
+    # wraps are jit roots exactly like raw jax.jit ones.
+    "tracked_jit",
+    "kernel_registry.tracked_jit",
+    "narwhal_tpu.tpu.kernel_registry.tracked_jit",
+    "kernel_registry.sharded",
+    "narwhal_tpu.tpu.kernel_registry.sharded",
+}
 
 
 @dataclass

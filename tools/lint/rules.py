@@ -11,6 +11,7 @@
 | no-per-item-rpc-in-loop   | RTT x items serialization on the commit data plane|
 | no-unbounded-channel      | default-capacity edges defeating admission control|
 | no-wall-clock-in-actors   | wall time leaking past the simnet virtual clock   |
+| no-untracked-jit          | duplicate multi-minute kernel compiles (rc=124)   |
 
 Rules are pure `ast` visitors over one `Module` at a time; registration is
 import-time via the `@register` decorator so `RULES` is the single catalog
@@ -354,7 +355,19 @@ class JitPurity(Rule):
     def _jit_roots(
         self, tree: ast.Module, aliases: dict[str, str], funcs: dict[str, ast.AST]
     ) -> set[str]:
-        jit_names = {"jax.jit", "jit"}
+        # kernel_registry.tracked_jit is the sanctioned jit wrapper in tpu/
+        # (no-untracked-jit); its decoratees are jit roots exactly like raw
+        # @jax.jit ones, and registry.sharded(fn, ...) wraps are the
+        # sharded-kernel analog of `name = jax.jit(fn)`.
+        jit_names = {
+            "jax.jit",
+            "jit",
+            "tracked_jit",
+            "kernel_registry.tracked_jit",
+            "narwhal_tpu.tpu.kernel_registry.tracked_jit",
+            "kernel_registry.sharded",
+            "narwhal_tpu.tpu.kernel_registry.sharded",
+        }
         roots: set[str] = set()
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -984,3 +997,64 @@ class NoWallClockInActors(Rule):
                     "only the clock seam keeps the discipline greppable "
                     "and simnet-sound",
                 )
+
+
+# ---------------------------------------------------------------------------
+# no-untracked-jit
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoUntrackedJit(Rule):
+    name = "no-untracked-jit"
+    summary = (
+        "in tpu/, every jit entry point must route through the shared "
+        "kernel registry (kernel_registry.tracked_jit / .sharded): a raw "
+        "jax.jit owns its own private compile cache, so two wrappers over "
+        "the same kernel+mesh each pay the full multi-minute XLA compile "
+        "— the MULTICHIP rc=124 failure class — and its compile wall is "
+        "invisible to the registry's per-(kernel, mesh shape) accounting"
+    )
+
+    _JIT = {"jax.jit", "jit"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if "tpu" not in PurePath(mod.rel).parts:
+            return
+        if mod.path.name == "kernel_registry.py":  # the sanctioned wrapper
+            return
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    site = deco
+                    d = resolve(deco, aliases)
+                    if isinstance(deco, ast.Call):
+                        d = resolve(deco.func, aliases)
+                        if (
+                            d in ("partial", "functools.partial")
+                            and deco.args
+                            and resolve(deco.args[0], aliases) in self._JIT
+                        ):
+                            d = resolve(deco.args[0], aliases)
+                    if d in self._JIT:
+                        yield self.finding(
+                            mod,
+                            site,
+                            f"`@{ast.unparse(deco)}` on `{node.name}` "
+                            "bypasses the shared kernel registry — use "
+                            "`@kernel_registry.tracked_jit` so the compile "
+                            "is deduped and its wall is accounted per "
+                            "(kernel, mesh shape)",
+                        )
+            elif isinstance(node, ast.Call):
+                if resolve(node.func, aliases) in self._JIT:
+                    yield self.finding(
+                        mod,
+                        node,
+                        "`jax.jit(...)` called outside the kernel registry "
+                        "— sharded/mesh variants must come from "
+                        "`kernel_registry.sharded(...)` (one compile per "
+                        "(kernel, mesh shape) per process), module-level "
+                        "kernels from `@kernel_registry.tracked_jit`",
+                    )
