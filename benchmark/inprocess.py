@@ -217,6 +217,7 @@ async def run_bench(args) -> dict:
         "dag_backend": args.dag_backend,
         "dag_shards": args.dag_shards,
         "cert_format": args.cert_format,
+        "verify_rule": "cofactored" if args.crypto_backend == "tpu" else "strict",
         "executed_tps": round(tps, 1),
         "executed_total": executed[0],
         "committed_rounds_in_window": round(committed_rounds, 1),
@@ -291,9 +292,10 @@ def main() -> None:
     ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--dag-shards", type=int, default=1)
     ap.add_argument("--cert-format", choices=("full", "compact"),
-                    default="full",
+                    default="compact",
                     help="certificate wire form (compact = half-aggregated "
-                    "proofs broadcast by reference)")
+                    "proofs broadcast by reference — the committee default; "
+                    "full = the per-signer opt-out)")
     ap.add_argument("--no-precompile", action="store_true",
                     help="skip the tpu verify-bucket warmup before boot")
     ap.add_argument("--out", default=None,

@@ -74,6 +74,8 @@ async def run_liveness(args) -> dict:
         parameters=Parameters(
             max_header_delay=args.max_header_delay,
             max_batch_delay=args.max_batch_delay,
+            cert_format=args.cert_format,
+            verify_rule=args.verify_rule,
         ),
     )
     t0 = time.time()
@@ -148,13 +150,19 @@ def run_liveness_simnet(args) -> dict:
     t_wall = time.time()
 
     async def drive() -> dict:
+        from narwhal_tpu.config import Parameters
+
         cluster = SimCluster(
             size=args.nodes,
             fabric=fabric,
             workers=args.workers,
             auth=not args.no_auth,
-            max_header_delay=args.max_header_delay,
-            max_batch_delay=args.max_batch_delay,
+            parameters=Parameters(
+                max_header_delay=args.max_header_delay,
+                max_batch_delay=args.max_batch_delay,
+                cert_format=args.cert_format,
+                verify_rule=args.verify_rule,
+            ),
         )
         t0 = time.time()
         await cluster.start(args.nodes - args.faults)
@@ -253,6 +261,11 @@ def _record(
         "committee_size": args.nodes,
         "workers_per_node": args.workers,
         "faults": args.faults,
+        # First-class experiment axes like W and faults: the certificate
+        # wire form moves the control-plane byte floor, the accept rule
+        # names the verification semantics the row ran under.
+        "cert_format": args.cert_format,
+        "verify_rule": args.verify_rule,
         "alive_nodes": alive,
         "parameters": {
             "max_header_delay_s": args.max_header_delay,
@@ -294,6 +307,13 @@ def main() -> None:
     ap.add_argument("--sample-interval", type=float, default=20.0)
     ap.add_argument("--max-header-delay", type=float, default=1.0)
     ap.add_argument("--max-batch-delay", type=float, default=0.5)
+    ap.add_argument("--cert-format", choices=("full", "compact"),
+                    default="compact",
+                    help="certificate wire form (committee-wide axis; "
+                    "compact = half-aggregated default, full = opt-out)")
+    ap.add_argument("--verify-rule", choices=("strict", "cofactored"),
+                    default="strict",
+                    help="per-item ed25519 accept set")
     ap.add_argument("--simnet", action="store_true",
                     help="socket-free virtual-clock transport: no fd "
                     "ceiling, N=200+ committees fit in one process")

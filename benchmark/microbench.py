@@ -619,6 +619,85 @@ def bench_codec() -> list[dict]:
     return out
 
 
+def bench_compact_verify(
+    committee_size: int = 50, batches: tuple = (1, 8, 32, 64)
+) -> list[dict]:
+    """Host compact-certificate proof verification: the batched
+    randomized-linear-combination MSM (types.host_batch_verify_aggregates,
+    what the cpu/pool group lane dispatches) vs the per-item
+    host_verify_aggregate fallback, at the north-star committee size
+    (quorum = 34 signers/cert at N=50). Fresh certificates per batch so the
+    aggregate-verdict cache never hides the group math; the acceptance bar
+    is >=5x per-signature at batch >= 32."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.types import (
+        Certificate,
+        Header,
+        Vote,
+        host_batch_verify_aggregates,
+        host_verify_aggregate,
+    )
+
+    f = CommitteeFixture(size=committee_size)
+    committee = f.committee
+    quorum_n = 0
+    stake = 0
+    for pk in committee.authority_keys():
+        quorum_n += 1
+        stake += committee.stake(pk)
+        if stake >= committee.quorum_threshold():
+            break
+    voters = f.authorities[:quorum_n]
+
+    serial = 0
+
+    def fresh_groups(count: int):
+        nonlocal serial
+        groups = []
+        for _ in range(count):
+            serial += 1
+            author = f.authorities[serial % committee_size]
+            h = Header.build(
+                author.public, 1, 0,
+                {serial.to_bytes(32, "little"): 0},
+                frozenset(c.digest for c in Certificate.genesis(committee)),
+                author.signature_service(),
+            )
+            votes = [
+                Vote.for_header(h, a.public, a.signature_service()) for a in voters
+            ]
+            signers, sigs = zip(
+                *sorted((committee.index_of(v.author), v.signature) for v in votes)
+            )
+            cert = Certificate.compact_from_votes(h, tuple(signers), tuple(sigs))
+            groups.append(cert.aggregate_group(committee))
+        return groups
+
+    out = []
+    for batch in batches:
+        groups = fresh_groups(batch)
+        sigs = sum(len(g[0]) for g in groups)
+        t0 = time.perf_counter()
+        assert all(host_batch_verify_aggregates(groups))
+        batched_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        assert all(host_verify_aggregate(*g) for g in groups)
+        per_item_s = time.perf_counter() - t0
+        out.append(
+            {
+                "metric": f"compact_verify[N={committee_size},batch={batch}]",
+                "signers_per_cert": quorum_n,
+                "signatures": sigs,
+                "batched_s": round(batched_s, 4),
+                "per_item_s": round(per_item_s, 4),
+                "batched_us_per_sig": round(1e6 * batched_s / sigs, 1),
+                "per_item_us_per_sig": round(1e6 * per_item_s / sigs, 1),
+                "speedup": round(per_item_s / batched_s, 2),
+            }
+        )
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(prog="benchmark.microbench")
     ap.add_argument("--profile", action="store_true", help="cProfile the consensus bench")
@@ -634,6 +713,9 @@ def main() -> None:
     ap.add_argument("--pacing", action="store_true",
                     help="run ONLY the adaptive-vs-fixed seal latency bench "
                          "(ingest->seal percentiles through a real BatchMaker)")
+    ap.add_argument("--compact-verify", action="store_true",
+                    help="run ONLY the batched-vs-per-item host compact "
+                         "certificate proof verification bench")
     ap.add_argument("--out", default=None,
                     help="also write the selected benches as a JSON array to this path")
     args = ap.parse_args()
@@ -646,6 +728,8 @@ def main() -> None:
         rows += bench_commit_path()
     elif args.pacing:
         rows += bench_pacing()
+    elif args.compact_verify:
+        rows += bench_compact_verify()
     elif args.dag_service:
         rows += bench_dag_service()
     else:

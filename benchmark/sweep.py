@@ -40,6 +40,8 @@ def run_once(rate: int, args) -> dict:
         node_parameters=Parameters(
             max_header_delay=args.max_header_delay,
             max_batch_delay=args.max_batch_delay,
+            cert_format=args.cert_format,
+            verify_rule=args.verify_rule,
         ),
     )
     parser = bench.run()
@@ -48,10 +50,14 @@ def run_once(rate: int, args) -> dict:
     record["crypto_backend"] = args.crypto_backend
     record["dag_backend"] = args.dag_backend
     record["dag_shards"] = args.dag_shards
-    # Self-describing A/B rows: W and the crash-fault count are part of the
-    # experiment's identity (the reference bench records `faults` too).
+    # Self-describing A/B rows: W, the crash-fault count, and the
+    # certificate wire form / accept rule are part of the experiment's
+    # identity (the reference bench records `faults` too; cert_format moves
+    # the wire floor the same way W moves the payload plane).
     record["workers_per_node"] = args.workers
     record["faults"] = args.faults
+    record["cert_format"] = args.cert_format
+    record["verify_rule"] = args.verify_rule
     print(
         f"  rate {rate:>8,}: TPS {record['consensus_tps']:>10,.0f}  "
         f"lat {record['consensus_latency_ms']:>8,.0f} ms  "
@@ -123,6 +129,13 @@ def main() -> None:
                     default="cpu")
     ap.add_argument("--dag-backend", choices=("cpu", "tpu"), default="cpu")
     ap.add_argument("--dag-shards", type=int, default=1)
+    ap.add_argument("--cert-format", choices=("full", "compact"),
+                    default="compact",
+                    help="certificate wire form (committee-wide axis)")
+    ap.add_argument("--verify-rule", choices=("strict", "cofactored"),
+                    default="strict",
+                    help="per-item ed25519 accept set (cofactored requires "
+                    "--crypto-backend tpu)")
     ap.add_argument("--max-header-delay", type=float, default=0.1)
     ap.add_argument("--max-batch-delay", type=float, default=0.1)
     ap.add_argument("--rates", type=int, nargs="*", default=[5_000, 15_000, 30_000])

@@ -54,27 +54,38 @@ class Parameters:
     block_synchronizer_payload_retries: int = 5
     consensus_api_grpc_address: str = "127.0.0.1:0"
     prometheus_address: str = "127.0.0.1:0"
-    # Committee-wide ed25519 accept set — every node MUST use the same rule
-    # or adversarially crafted torsion-component signatures make honest
-    # nodes disagree (a consensus-split vector; see
-    # narwhal_tpu/tpu/verifier.py msm_epilogue_check):
+    # Committee-wide ed25519 accept set for PER-ITEM signatures (headers,
+    # votes, full-format certificate vote vectors) — every node MUST use
+    # the same rule or adversarially crafted torsion-component signatures
+    # make honest nodes disagree (a consensus-split vector; see
+    # narwhal_tpu/tpu/verifier.py msm_epilogue_check). Validated at node
+    # assembly (ConfigError on anything else):
     #   strict     — the host library's cofactorless rule (ed25519-dalek
     #                `verify` semantics); supported by every crypto backend.
     #   cofactored — RFC 8032 batch rule (ed25519-dalek `batch_verify`
-    #                semantics); only the tpu backend implements it, and it
-    #                unlocks the msm batch kernel. Nodes on cpu/pool
-    #                backends refuse to start under this rule.
+    #                semantics); only the tpu backend's msm kernel applies
+    #                it per-item, so cpu/pool nodes refuse to start under
+    #                this rule. Note compact-certificate PROOFS are
+    #                cofactored on every backend by construction (the
+    #                half-aggregated equation admits no other rule) —
+    #                verify_rule only governs per-item checks.
     verify_rule: str = "strict"
     # Certificate wire form — committee-wide (mixed committees would
     # disagree about certificate bytes):
-    #   full    — one 64-byte ed25519 signature per signer (reference-like).
-    #   compact — half-aggregated: 32-byte R per signer + one 32-byte
-    #             aggregate scalar (~2x smaller proofs, O(N) -> O(N)/2+32B;
-    #             see types.py Certificate). Verification is the msm
-    #             kernel's native equation; the host fallback is slow, so
-    #             compact committees should run --crypto-backend tpu.
-    #             Acceptance is inherently the cofactored rule.
-    cert_format: str = "full"
+    #   compact — the DEFAULT: half-aggregated, 32-byte R per signer + one
+    #             32-byte aggregate scalar (~2x smaller proofs, and the
+    #             broadcast sheds the header body via CertificateRefMsg —
+    #             3.2x smaller announcements measured at N=50; see types.py
+    #             Certificate). Every backend verifies proofs batched: the
+    #             tpu backend fuses groups into one device msm dispatch,
+    #             cpu/pool run the same randomized-linear-combination rule
+    #             over one host bucket-method MSM per flush
+    #             (types.host_batch_verify_aggregates), amortizing the
+    #             group math across every certificate in a dispatch.
+    #   full    — the opt-out: one 64-byte ed25519 signature per signer
+    #             (reference-like). Every node always ACCEPTS both forms on
+    #             the wire; this picks what the committee assembles.
+    cert_format: str = "compact"
     # Byte budget for the executor's speculative payload prefetcher
     # (executor/prefetcher.py): unclaimed pre-commit payload held in the
     # temp batch store never exceeds this; 0 disables prefetching entirely.

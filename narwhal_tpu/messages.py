@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 from .bounded_cache import BoundedCache
 from .codec import Reader, Writer
 from .config import Committee
-from .crypto import DIGEST_LEN, PUBLIC_KEY_LEN
+from .crypto import DIGEST_LEN, PUBLIC_KEY_LEN, SIGNATURE_LEN
 from .types import Batch, Certificate, Digest, Header, PublicKey, Round, Vote, WorkerId
 
 REGISTRY: dict[int, type] = {}
@@ -565,6 +565,126 @@ class CertificateDeltaMsg:
             tuple(r.seq(lambda r_: r_.u16())),
             tuple(r.seq(lambda r_: r_.raw(64))),
         )
+
+
+@message(79)
+@dataclass
+class Relay2Msg:
+    """Slim fanout-tree envelope (the wire-diet successor of RelayMsg —
+    which stays accepted): the origin is a 2-byte committee index, round and
+    epoch shrink to u32/u16, and the inner announcement rides as a
+    purpose-built compact body instead of a self-describing message, so the
+    envelope stops re-shipping fields the announcement also carries:
+
+      kind 1 — delta header: header_digest | parents BITMAP (one bit per
+               committee index at round-1) | 64-byte signature | payload
+               (digest, u16 worker) pairs; author/round/epoch come from the
+               envelope. ~150 B at N=50 vs ~260 for DeltaHeaderMsg-in-RelayMsg.
+      kind 2 — compact certificate: header_digest | agg_s | signer BITMAP |
+               the 32-byte R nonces in signer order. Saves the duplicated
+               origin/round/epoch and the u32-per-signer index list of
+               CertificateRefMsg (~190 B/certificate/link at N=50).
+      kind 0 — generic: u16 inner tag | raw body (any announcement the slim
+               kinds cannot express: full HeaderMsg fallbacks, full-format
+               CertificateMsg/CertificateDeltaMsg).
+
+    Decoding kinds 1/2 back into DeltaHeaderMsg/CertificateRefMsg needs the
+    committee (index->key, bitmap width) and happens in primary/fanout.py;
+    receivers then run the EXACT resolution paths the fat forms take. The
+    ack id every hop agrees on covers the whole envelope identity."""
+
+    origin_index: int  # committee dense index of the broadcasting authority
+    round: Round
+    epoch: int
+    kind: int  # 0 generic | 1 delta header | 2 compact certificate
+    body: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.u16(self.origin_index)
+        w.u32(self.round)
+        w.u16(self.epoch)
+        w.u8(self.kind)
+        w.raw(self.body)
+
+    @staticmethod
+    def decode(r: Reader) -> "Relay2Msg":
+        return Relay2Msg(r.u16(), r.u32(), r.u16(), r.u8(), r.rest())
+
+    @property
+    def ack_id(self) -> Digest:
+        from .crypto import digest256
+
+        return digest256(
+            b"R2"
+            + self.origin_index.to_bytes(2, "little")
+            + self.round.to_bytes(4, "little")
+            + self.epoch.to_bytes(2, "little")
+            + bytes([self.kind])
+            + self.body
+        )
+
+
+@message(81)
+@dataclass
+class Vote2Msg:
+    """Slim vote (the wire-diet successor of VoteMsg, which stays
+    accepted): a vote always travels to the HEADER AUTHOR, who can
+    reconstruct round/epoch/origin from the header digest (its own current
+    header, or its header store) — so the wire carries only the digest,
+    the voter, and the signature (128 B vs 180). The rebuilt Vote's signed
+    message is a pure function of the reconstructed fields, so a forged or
+    misdirected slim vote simply fails signature verification."""
+
+    header_digest: Digest
+    author: PublicKey  # the voter
+    signature: bytes  # 64-byte ed25519
+
+    @staticmethod
+    def from_vote(vote: Vote) -> "Vote2Msg":
+        return Vote2Msg(vote.header_digest, vote.author, vote.signature)
+
+    def rebuild(self, header: Header) -> Vote:
+        return Vote(
+            self.header_digest,
+            header.round,
+            header.epoch,
+            header.author,
+            self.author,
+            self.signature,
+        )
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.header_digest)
+        w.raw(self.author)
+        w.raw(self.signature)
+
+    @staticmethod
+    def decode(r: Reader) -> "Vote2Msg":
+        return Vote2Msg(
+            r.raw(DIGEST_LEN), r.raw(PUBLIC_KEY_LEN), r.raw(SIGNATURE_LEN)
+        )
+
+
+@message(80)
+@dataclass
+class RelayAck2Msg:
+    """Slim receipt confirmation for Relay2Msg broadcasts, sent as a
+    fire-and-forget KIND_ONEWAY frame (no RPC Ack response — delivery of
+    the ack itself is best-effort by design: a lost ack costs the origin
+    one fallback direct send). The acker is the handshake-verified peer on
+    authenticated meshes; the carried committee index is only trusted on
+    open (bare-test) meshes, like RelayAckMsg's name field."""
+
+    ack_id: Digest
+    acker_index: int
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.ack_id)
+        w.u16(self.acker_index)
+
+    @staticmethod
+    def decode(r: Reader) -> "RelayAck2Msg":
+        return RelayAck2Msg(r.raw(DIGEST_LEN), r.u16())
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,14 @@ _FRAME_HDR = struct.Struct("<IBQH")  # len, kind, rid, tag
 KIND_REQ = 0
 KIND_RESP = 1
 KIND_ERR = 2
+# Fire-and-forget request: the server dispatches the handler but writes NO
+# response frame, and the client tracks no rid. For high-frequency lanes
+# whose delivery is guaranteed by an APPLICATION-level mechanism (the relay
+# plane: origin-side ack tracking + direct fallback), the per-frame Ack
+# response and the retry-on-deadline resends of the RPC layer are pure
+# overhead — measured at N=50 they were ~10% of all control-plane bytes.
+# (KIND_HELLO = 3 lives in auth.py.)
+KIND_ONEWAY = 4
 
 MAX_FRAME = 64 << 20  # 64 MiB, > max batch size with generous headroom
 MAX_TASK_CONCURRENCY = 500  # per-peer cap (network/src/lib.rs:54)
@@ -506,6 +514,22 @@ class PeerClient:
             self._pending.pop(rid, None)
             raise RpcTimeout(f"request to {self.address} timed out")
 
+    async def oneway(self, msg) -> None:
+        """Enqueue a fire-and-forget frame (KIND_ONEWAY): no response, no
+        rid, no retry. The frame rides the same FrameSender (coalesced
+        writes, in-order AEAD sealing); a torn connection surfaces as
+        RpcError/OSError from the connect, and silently dropped frames are
+        the CALLER's contract — only use this where an application-level
+        mechanism (relay fallback) already guarantees delivery."""
+        if self._sender is None:
+            await self._connect()
+        tag, body = encode_message(msg)
+        try:
+            self._sender.send(KIND_ONEWAY, 0, tag, body)
+        except (ConnectionError, OSError) as e:
+            self._teardown(RpcError(str(e)))
+            raise RpcError(f"send to {self.address} failed: {e}") from e
+
     def close(self) -> None:
         self._teardown(RpcError("client closed"))
 
@@ -644,11 +668,13 @@ class RpcServer:
                 kind, rid, tag, body = await _read_frame(
                     reader, session, self._counters
                 )
-                if kind != KIND_REQ:
+                if kind != KIND_REQ and kind != KIND_ONEWAY:
                     continue
                 await sem.acquire()
                 t = asyncio.ensure_future(
-                    self._dispatch(sender, rid, tag, body, peer)
+                    self._dispatch(
+                        sender, rid, tag, body, peer, oneway=kind == KIND_ONEWAY
+                    )
                 )
                 tasks.add(t)
                 t.add_done_callback(lambda t_: (tasks.discard(t_), sem.release()))
@@ -672,6 +698,7 @@ class RpcServer:
         tag: int,
         body: bytes,
         peer: Peer,
+        oneway: bool = False,
     ) -> None:
         try:
             entry = self._handlers.get(tag)
@@ -682,6 +709,10 @@ class RpcServer:
                 raise RpcError(f"unauthorized peer for tag {tag}")
             msg = decode_message(tag, body)
             resp = await handler(msg, peer)
+            if oneway:
+                # Fire-and-forget frame: the handler ran, nothing to write
+                # back (any returned value is discarded by contract).
+                return
             if resp is None:
                 resp = Ack()
             rtag, rbody = encode_message(resp)
@@ -693,6 +724,8 @@ class RpcServer:
             # visibility too — a handler bug otherwise only surfaces as
             # remote retry noise.
             logger.debug("handler for tag %d raised: %r", tag, e)
+            if oneway:
+                return
             out = (KIND_ERR, rid, 0, str(e).encode())
         try:
             sender.send(*out)
@@ -758,6 +791,18 @@ class NetworkClient:
         traits.rs:10-40)."""
         try:
             await self.peer(address).request(msg, timeout)
+            return True
+        except (RpcError, OSError):
+            return False
+
+    async def oneway_send(self, address: str, msg) -> bool:
+        """Fire-and-forget: one KIND_ONEWAY frame, no response awaited, no
+        retry. True iff the frame was enqueued on a live connection. For
+        lanes with their own application-level delivery guarantee (the
+        relay plane's origin fallback) — a lost frame there costs one
+        fallback direct send, never correctness."""
+        try:
+            await self.peer(address).oneway(msg)
             return True
         except (RpcError, OSError):
             return False

@@ -124,7 +124,10 @@ class PrimaryNode:
         )
 
         # Crypto backend (the --crypto-backend flag of SURVEY §7.8c):
-        #   cpu  — inline host verification in the Core (reference behavior)
+        #   cpu  — inline host verification in the Core (reference
+        #          behavior) for full-format committees; under the compact
+        #          default it gains the async stage below so certificate
+        #          proofs batch (see the cert_format branch)
         #   pool — async coalescing stage over the host library
         #   tpu  — async coalescing stage over the TPU batch kernel
         # The accept set is a COMMITTEE-WIDE parameter (Parameters.
@@ -132,15 +135,24 @@ class PrimaryNode:
         # cofactorless ("strict"), the TPU msm batch kernel is RFC-8032
         # cofactored — a committee mixing the two can permanently disagree
         # on adversarially crafted torsion signatures.
+        # Committee-wide knobs are validated here at assembly with
+        # ConfigError — operator mistakes must stop the boot symmetrically
+        # (a verify_rule typo used to fall through to backend-specific
+        # errors while cert_format failed fast).
         rule = getattr(parameters, "verify_rule", "strict")
         if rule not in ("strict", "cofactored"):
-            raise ValueError(f"parameters.verify_rule must be strict|cofactored, got {rule!r}")
+            raise ConfigError(
+                f"parameters.verify_rule must be strict|cofactored, got {rule!r}"
+            )
         # cert_format is committee-wide wire format: a typo silently
-        # behaving as 'full' in a 'compact' committee would mix certificate
-        # wire forms instead of failing fast (advisor r4).
-        cert_format = getattr(parameters, "cert_format", "full")
+        # behaving as the non-default form would mix certificate wire forms
+        # instead of failing fast (advisor r4). Compact is the default on
+        # EVERY backend (each has a batched cofactored proof-verify path);
+        # 'full' is the opt-out, and all nodes accept both forms on the
+        # wire regardless.
+        cert_format = getattr(parameters, "cert_format", "compact")
         if cert_format not in ("full", "compact"):
-            raise ValueError(
+            raise ConfigError(
                 f"parameters.cert_format must be full|compact, got {cert_format!r}"
             )
         # header_wire only selects what WE send (every node accepts both
@@ -148,18 +160,20 @@ class PrimaryNode:
         # forfeit the wire diet — fail fast like cert_format.
         header_wire = getattr(parameters, "header_wire", "full")
         if header_wire not in ("full", "delta"):
-            raise ValueError(
+            raise ConfigError(
                 f"parameters.header_wire must be full|delta, got {header_wire!r}"
             )
         if rule == "cofactored" and crypto_backend != "tpu":
-            raise ValueError(
+            raise ConfigError(
                 "parameters.verify_rule=cofactored: only the tpu crypto "
-                f"backend implements the cofactored accept set (got "
+                f"backend implements the cofactored PER-ITEM accept set (got "
                 f"crypto_backend={crypto_backend!r}). Use --crypto-backend "
-                "tpu on every node, or set verify_rule=strict."
+                "tpu on every node, or set verify_rule=strict. (Compact "
+                "certificate proofs are cofactored on every backend and do "
+                "not require this rule.)"
             )
         if verify_shards > 1 and crypto_backend != "tpu":
-            raise ValueError(
+            raise ConfigError(
                 f"--verify-shards {verify_shards} requires --crypto-backend "
                 f"tpu (got {crypto_backend!r})"
             )
@@ -209,6 +223,18 @@ class PrimaryNode:
                 )
                 crypto_pool = AsyncVerifierPool()
         elif crypto_backend == "pool":
+            from .tpu.verifier import AsyncVerifierPool
+
+            crypto_pool = AsyncVerifierPool()
+        elif cert_format == "compact":
+            # cpu backend under the compact default: certificate proofs
+            # must ride the batched aggregate lane, not per-certificate
+            # inline host verification in the Core — the verifier stage's
+            # concurrent submissions coalesce into one
+            # host_batch_verify_aggregates MSM per flush (certificate
+            # GROUPS per dispatch, the non-TPU analog of the device group
+            # lane). Headers/votes share the stage's host batch path, same
+            # strict accept set as inline verification.
             from .tpu.verifier import AsyncVerifierPool
 
             crypto_pool = AsyncVerifierPool()

@@ -146,6 +146,9 @@ class BlockSynchronizer:
         lane fuses whole batches into one device dispatch). Semantics match
         the VerifierStage: structural checks inline, signatures batched."""
         if self.crypto_pool is None:
+            # Documented no-pool fallback (full-format cpu committees);
+            # compact proofs inside still take the cached single-group MSM.
+            # lint: allow(no-per-item-cert-verify)
             cert.verify(self.committee, self.worker_cache)
             return
         if cert.is_compact:

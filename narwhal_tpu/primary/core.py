@@ -244,10 +244,16 @@ class Core:
         if header.author == self.name:
             await self.process_vote(vote)
         else:
-            from ..messages import VoteMsg
+            from ..messages import Vote2Msg
 
+            # Slim wire form (the author reconstructs round/epoch/origin
+            # from its own header); generous per-attempt deadline — a
+            # deadline miss on a loaded committee means the author is slow,
+            # and the resent 200-byte frames were measurable at N=50.
             address = self.committee.primary_address(header.author)
-            handler = self.network.send(address, VoteMsg(vote))
+            handler = self.network.send(
+                address, Vote2Msg.from_vote(vote), timeout=30.0
+            )
             self.cancel_handlers.setdefault(header.round, []).append(handler)
             if self.metrics is not None:
                 self.metrics.votes_sent.inc()
@@ -256,6 +262,11 @@ class Core:
     # Vote path (core.rs:359-396)
     # ------------------------------------------------------------------
     async def process_vote(self, vote: Vote) -> None:
+        if self.fanout is not None:
+            # A vote proves the voter received our header broadcast — the
+            # implicit receipt that replaces explicit relay acks on the
+            # slim header lane (fanout.note_vote).
+            self.fanout.note_vote(vote.round, vote.author)
         if self.current_header is None or vote.header_digest != self.current_header.digest:
             return  # vote for an old header of ours
         certificate = self.votes_aggregator.append(
@@ -371,6 +382,12 @@ class Core:
             # structural/stake checks (no message/weight recomputation).
             certificate.structural_verify(self.committee)
         else:
+            # Terminal no-pool fallback (full-format cpu committees and the
+            # block-synchronizer loopback): Certificate.verify itself rides
+            # the cached single-group MSM for compact proofs, so the
+            # loopback re-check of an already-pool-verified fetch is a
+            # process-wide cache hit.
+            # lint: allow(no-per-item-cert-verify)
             certificate.verify(self.committee, self.worker_cache)
 
     def _observe_round(self, round: Round) -> None:

@@ -39,13 +39,142 @@ import logging
 
 from ..clock import now
 from ..channels import CancelOnDrop
+from ..codec import Reader, Writer
 from ..config import Committee
-from ..crypto import digest256
-from ..messages import RelayAckMsg, RelayMsg, encode_message
+from ..crypto import DIGEST_LEN, digest256
+from ..messages import (
+    CertificateRefMsg,
+    DeltaHeaderMsg,
+    Relay2Msg,
+    RelayAck2Msg,
+    RelayAckMsg,
+    RelayMsg,
+    decode_message,
+    encode_message,
+)
 from ..network import NetworkClient
 from ..types import Digest, PublicKey, Round
 
 logger = logging.getLogger("narwhal.primary")
+
+# Relay2Msg body kinds (messages.Relay2Msg docstring).
+R2_GENERIC = 0
+R2_DELTA_HEADER = 1
+R2_CERT_REF = 2
+
+
+def _bitmap(indices, size: int) -> bytes:
+    out = bytearray(-(-size // 8))
+    for i in indices:
+        out[i >> 3] |= 1 << (i & 7)
+    return bytes(out)
+
+
+def _bitmap_indices(bitmap: bytes) -> list[int]:
+    return [
+        (byte_i << 3) + bit
+        for byte_i, b in enumerate(bitmap)
+        for bit in range(8)
+        if b & (1 << bit)
+    ]
+
+
+def encode_relay2(committee: Committee, name: PublicKey, round: Round, msg) -> Relay2Msg | None:
+    """The slim relay envelope for our own announcement, or None when the
+    slim ranges don't fit (huge round/epoch, foreign origin) — the caller
+    then falls back to the legacy RelayMsg. Announcement fields duplicated
+    by the envelope (origin, round, epoch) are DROPPED from the body; the
+    receiver's decode_relay2 reconstitutes the exact fat message, so the
+    resolution paths downstream never know the diet happened."""
+    epoch = committee.epoch
+    if round >= 1 << 32 or epoch >= 1 << 16:
+        return None
+    try:
+        origin_index = committee.index_of(name)
+    except KeyError:
+        return None
+    n = committee.size()
+    w = Writer()
+    if (
+        isinstance(msg, CertificateRefMsg)
+        and msg.origin == name
+        and msg.round == round
+        and msg.epoch == epoch
+        and len(msg.agg_s) == 32
+        and len(msg.rs) == len(msg.signers)
+        and all(len(r) == 32 for r in msg.rs)
+        and all(0 <= i < n for i in msg.signers)
+        and list(msg.signers) == sorted(set(msg.signers))
+    ):
+        w.raw(msg.header_digest)
+        w.raw(msg.agg_s)
+        w.bytes(_bitmap(msg.signers, n))
+        for r in msg.rs:  # signer-index order == ascending bitmap order
+            w.raw(r)
+        return Relay2Msg(origin_index, round, epoch, R2_CERT_REF, w.finish())
+    if (
+        isinstance(msg, DeltaHeaderMsg)
+        and msg.author == name
+        and msg.round == round
+        and msg.epoch == epoch
+        and len(msg.signature) == 64
+        and all(0 <= i < n for i in msg.parent_indices)
+        and list(msg.parent_indices) == sorted(set(msg.parent_indices))
+        and all(0 <= wid < 1 << 16 for _, wid in msg.payload)
+    ):
+        w.raw(msg.header_digest)
+        w.bytes(_bitmap(msg.parent_indices, n))
+        w.raw(msg.signature)
+
+        def enc_pair(w_: Writer, item) -> None:
+            w_.raw(item[0])
+            w_.u16(item[1])
+
+        w.seq(msg.payload, enc_pair)
+        return Relay2Msg(origin_index, round, epoch, R2_DELTA_HEADER, w.finish())
+    tag, body = encode_message(msg)
+    w.u16(tag)
+    w.raw(body)
+    return Relay2Msg(origin_index, round, epoch, R2_GENERIC, w.finish())
+
+
+def decode_relay2(committee: Committee, msg: Relay2Msg):
+    """Reconstitute the fat announcement a Relay2Msg carries. Raises
+    ValueError/CodecError on anything malformed — byzantine envelopes can
+    only be dropped (and the origin's own tree position is derived from the
+    envelope, so a forged origin only mis-roots a tree the inner message's
+    signature checks still gate)."""
+    keys = committee.authority_keys()
+    if msg.origin_index >= len(keys):
+        raise ValueError(f"origin index {msg.origin_index} out of range")
+    origin = keys[msg.origin_index]
+    r = Reader(msg.body)
+    if msg.kind == R2_GENERIC:
+        tag = r.u16()
+        return decode_message(tag, r.rest())
+    if msg.kind == R2_CERT_REF:
+        header_digest = r.raw(DIGEST_LEN)
+        agg_s = r.raw(32)
+        signers = tuple(_bitmap_indices(r.bytes()))
+        if any(i >= len(keys) for i in signers):
+            raise ValueError("signer bitmap exceeds committee")
+        rs = tuple(r.raw(32) for _ in signers)
+        r.done()
+        return CertificateRefMsg(
+            header_digest, msg.round, msg.epoch, origin, signers, rs, agg_s
+        )
+    if msg.kind == R2_DELTA_HEADER:
+        header_digest = r.raw(DIGEST_LEN)
+        parents = tuple(_bitmap_indices(r.bytes()))
+        if any(i >= len(keys) for i in parents):
+            raise ValueError("parent bitmap exceeds committee")
+        signature = r.raw(64)
+        payload = tuple(r.seq(lambda r_: (r_.raw(DIGEST_LEN), r_.u16())))
+        r.done()
+        return DeltaHeaderMsg(
+            origin, msg.round, msg.epoch, header_digest, payload, parents, signature
+        )
+    raise ValueError(f"unknown relay2 kind {msg.kind}")
 
 
 def relay_order(committee: Committee, epoch: int, round: Round, origin: PublicKey) -> list[PublicKey]:
@@ -163,6 +292,15 @@ class FanoutBroadcaster:
         # bytes/round and halved rounds/s. Waiting ~4 observed latencies
         # keeps the fallback a crash-recovery path, not a steady-state one.
         self._ack_latency_ewma: float | None = None
+        # round -> ack_id of OUR header broadcast at that round: votes are
+        # implicit receipt confirmations (a vote travels to the broadcast's
+        # origin — us — and proves the voter processed the header), so
+        # receivers skip the explicit RelayAck2Msg for slim header relays
+        # entirely. Peers that receive but cannot vote (suspended on
+        # missing parents/payload) simply get one fallback direct send —
+        # dedup'd on arrival, and the vote-fed latency EWMA keeps that
+        # fallback deadline honest under load.
+        self._header_ack_ids: dict[Round, Digest] = {}
         # Short-lived best-effort tasks (ack sends), kept strongly.
         self._tasks: set[asyncio.Task] = set()
         self._trees = _TreeCache()
@@ -185,9 +323,13 @@ class FanoutBroadcaster:
             handles = self.network.broadcast([a for _, a, _ in others], msg)
             self._round_handles.setdefault(round, []).extend(handles)
             return handles
-        tag, body = encode_message(msg)
-        ack_id = digest256(tag.to_bytes(2, "little") + body)
-        relay = RelayMsg(self.name, round, self.committee.epoch, tag, body)
+        relay = encode_relay2(self.committee, self.name, round, msg)
+        if relay is not None:
+            ack_id = relay.ack_id
+        else:  # slim ranges don't fit: legacy fat envelope
+            tag, body = encode_message(msg)
+            ack_id = digest256(tag.to_bytes(2, "little") + body)
+            relay = RelayMsg(self.name, round, self.committee.epoch, tag, body)
         children = self._trees.children(
             self.committee, self.committee.epoch, round, self.name, self.name,
             self.fanout,
@@ -196,10 +338,18 @@ class FanoutBroadcaster:
         self._acks[ack_id] = acked
         self._ack_round[ack_id] = round
         self._ack_t0[ack_id] = now()
+        if isinstance(relay, Relay2Msg) and relay.kind == R2_DELTA_HEADER:
+            self._header_ack_ids[round] = ack_id
         handles = []
+        # Per-attempt deadline scaled to observed relay reality (like the
+        # fallback deadline): a fixed 10 s deadline on a committee whose
+        # broadcasts take seconds re-sends kilobyte envelopes to SLOW peers
+        # — pure wire waste the receiver dedups.
+        send_timeout = max(10.0, self._fallback_delay())
         for child in children:
             handle = self.network.send(
-                self.committee.primary_address(child), relay
+                self.committee.primary_address(child), relay,
+                timeout=send_timeout,
             )
             handle.task.add_done_callback(
                 lambda t, pk=child, a=ack_id: (
@@ -255,19 +405,22 @@ class FanoutBroadcaster:
         )
         if self.metrics is not None:
             self.metrics.relay_fallback_sends.inc(len(missing))
+        send_timeout = max(10.0, self._fallback_delay())
         handles = [
-            self.network.send(self.committee.primary_address(pk), msg)
+            self.network.send(
+                self.committee.primary_address(pk), msg, timeout=send_timeout
+            )
             for pk in missing
         ]
         self._round_handles.setdefault(round, []).extend(handles)
 
     # -- relay side --------------------------------------------------------
     def on_relay(self, msg: RelayMsg) -> None:
-        """Forward the unchanged envelope to our children in the origin's
-        tree and confirm receipt to the origin. Local delivery of the inner
-        message is the caller's job (Primary routes it through the normal
-        ingest paths). Non-blocking: forwards are reliable-send background
-        handles, the ack a tracked best-effort task."""
+        """Forward the unchanged LEGACY envelope to our children in the
+        origin's tree and confirm receipt to the origin. Local delivery of
+        the inner message is the caller's job (Primary routes it through
+        the normal ingest paths). Non-blocking: forwards are reliable-send
+        background handles, the ack a tracked best-effort task."""
         if msg.epoch != self.committee.epoch or msg.origin == self.name:
             # Cross-epoch relays can't place us in a tree we agree on; the
             # inner message still buffers/drops through the core's epoch
@@ -297,6 +450,73 @@ class FanoutBroadcaster:
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
+    def on_relay2(self, msg: Relay2Msg, origin: PublicKey) -> None:
+        """Relay2 receive side: forward the unchanged slim envelope to our
+        children and ack the origin — both as fire-and-forget KIND_ONEWAY
+        frames. The per-hop RPC Ack and retry machinery are deliberately
+        skipped: delivery of the WHOLE broadcast is guaranteed by the
+        origin's ack tracking + direct fallback, so a frame lost on a torn
+        connection costs one fallback send, while the removed response
+        frames and deadline resends were ~10% of all control-plane bytes
+        at N=50."""
+        if msg.epoch != self.committee.epoch or origin == self.name:
+            return
+        children = self._trees.children(
+            self.committee, msg.epoch, msg.round, origin, self.name,
+            self.fanout,
+        )
+        sends = [
+            self.network.oneway_send(self.committee.primary_address(child), msg)
+            for child in children
+            if child != origin
+        ]
+        if self.metrics is not None and sends:
+            self.metrics.relays_forwarded.inc(len(sends))
+        try:
+            my_index = self.committee.index_of(self.name)
+            origin_address = self.committee.primary_address(origin)
+        except KeyError:
+            my_index = None
+        # Slim header relays are acked IMPLICITLY by the vote we send the
+        # author (note_vote at the origin); only non-header relays need an
+        # explicit receipt.
+        if my_index is not None and msg.kind != R2_DELTA_HEADER:
+            sends.append(
+                self.network.oneway_send(
+                    origin_address, RelayAck2Msg(msg.ack_id, my_index)
+                )
+            )
+        for coro in sends:
+            task = asyncio.ensure_future(coro)
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    def note_vote(self, round: Round, voter: PublicKey) -> None:
+        """A vote for OUR round-`round` header arrived: the voter provably
+        received (and processed) the header broadcast — the implicit
+        receipt that replaces explicit RelayAck2Msg frames on the slim
+        header lane."""
+        ack_id = self._header_ack_ids.get(round)
+        if ack_id is not None:
+            self._mark_acked(ack_id, voter)
+
+    def on_ack2(self, msg: RelayAck2Msg, peer_key: PublicKey | None) -> None:
+        """Slim receipt confirmation: handshake-verified identity wins, the
+        carried committee index is only trusted on open meshes (the
+        RelayAckMsg discipline)."""
+        if peer_key is not None:
+            acker = self._authority_of_network_key.get(peer_key)
+        else:
+            keys = self.committee.authority_keys()
+            acker = (
+                keys[msg.acker_index] if msg.acker_index < len(keys) else None
+            )
+        if acker is None or msg.ack_id not in self._acks:
+            return
+        self._mark_acked(msg.ack_id, acker)
+        if self.metrics is not None:
+            self.metrics.relay_acks_received.inc()
+
     def on_ack(self, msg: RelayAckMsg, peer_key: PublicKey | None) -> None:
         """Record a receipt confirmation. The acker identity comes from the
         handshake-verified peer network key when the mesh is authenticated;
@@ -325,6 +545,8 @@ class FanoutBroadcaster:
             del self._ack_round[ack_id]
             self._acks.pop(ack_id, None)
             self._ack_t0.pop(ack_id, None)
+        for r in [r for r in self._header_ack_ids if r <= gc_round]:
+            del self._header_ack_ids[r]
 
     def change_epoch(self, committee: Committee) -> None:
         self.committee = committee
@@ -338,6 +560,11 @@ class FanoutBroadcaster:
         self._acks.clear()
         self._ack_round.clear()
         self._ack_t0.clear()
+        self._header_ack_ids.clear()
+        # Ack latencies of the old epoch say nothing about the new
+        # committee — and an inflated stale EWMA would slow the fallback
+        # exactly when cross-epoch slim relays depend on it for delivery.
+        self._ack_latency_ewma = None
         self._trees.clear()
 
     def shutdown(self) -> None:

@@ -301,6 +301,13 @@ class Primary:
         # Wire-diet plane: relay envelopes + delta announcements + resync.
         self.server.route(RelayMsg, self._on_relay, allow=allow_peer_primary)
         self.server.route(RelayAckMsg, self._on_relay_ack, allow=allow_peer_primary)
+        from ..messages import Relay2Msg, RelayAck2Msg, Vote2Msg
+
+        self.server.route(Relay2Msg, self._on_relay2, allow=allow_peer_primary)
+        self.server.route(
+            RelayAck2Msg, self._on_relay_ack2, allow=allow_peer_primary
+        )
+        self.server.route(Vote2Msg, self._on_vote2, allow=allow_peer_primary)
         self.server.route(
             DeltaHeaderMsg, self._on_delta_header, allow=allow_peer_primary
         )
@@ -388,6 +395,32 @@ class Primary:
         await self._ingest(msg.certificate)
         return None
 
+    async def _on_vote2(self, msg, peer: str):
+        """Slim vote: reconstruct the full Vote from the header it
+        endorses — our current header in the common case, the header store
+        for a late one. A vote can OUTRUN our own proposal processing (the
+        broadcast leaves before the core stores the header; on a loaded
+        1-core host the voter's round trip can win that race), so a miss
+        WAITS on the store instead of dropping: the RPC ack tells the
+        voter's reliable send the vote landed, so a silent drop here would
+        lose the vote forever — fatal in a committee whose quorum needs
+        every survivor. The reconstructed fields are covered by the vote
+        signature, so a forged rebuild can only fail verification."""
+        header = self.core.current_header
+        if header is None or header.digest != msg.header_digest:
+            header = self.header_store.read(msg.header_digest)
+        if header is None:
+            try:
+                header = await asyncio.wait_for(
+                    self.header_store.notify_read(msg.header_digest), timeout=3.0
+                )
+            except asyncio.TimeoutError:
+                return None  # genuinely unknown header: stale/forged vote
+        if header.author != self.name:
+            return None
+        await self._ingest(msg.rebuild(header))
+        return None
+
     async def _on_relay(self, msg: RelayMsg, peer: str):
         """Fanout-tree envelope: forward to our children in the origin's
         tree + ack the origin (both non-blocking), then deliver the inner
@@ -415,6 +448,42 @@ class Primary:
 
     async def _on_relay_ack(self, msg: RelayAckMsg, peer):
         self.fanout.on_ack(msg, getattr(peer, "key", None))
+        return None
+
+    async def _on_relay2(self, msg, peer: str):
+        """Slim fanout-tree envelope: reconstitute the fat announcement
+        (purpose-built compact body -> DeltaHeaderMsg/CertificateRefMsg),
+        forward + ack one-way, then deliver through the identical ingest
+        path the fat forms take."""
+        from .fanout import decode_relay2
+
+        if msg.epoch != self.committee.epoch:
+            # Slim bodies are keyed to the SENDER's committee (origin and
+            # bitmap positions are dense indices): across an epoch boundary
+            # our index->key mapping may differ, so decoding would
+            # reconstitute the announcement under the WRONG authorities.
+            # Drop it — the origin's fallback delivers the fat form, which
+            # the Core's next-epoch buffer then handles (the epoch-change
+            # deadlock fix stays intact, one fallback deadline later).
+            logger.debug(
+                "dropping cross-epoch relay2 (epoch %s != %s); origin "
+                "fallback covers delivery",
+                msg.epoch,
+                self.committee.epoch,
+            )
+            return None
+        try:
+            inner = decode_relay2(self.committee, msg)
+        except Exception as e:
+            logger.warning("relay2 with undecodable body: %s", e)
+            return None
+        origin = self.committee.key_of(msg.origin_index)
+        self.fanout.on_relay2(msg, origin)
+        await self._deliver_announcement(inner, peer)
+        return None
+
+    async def _on_relay_ack2(self, msg, peer):
+        self.fanout.on_ack2(msg, getattr(peer, "key", None))
         return None
 
     async def _on_delta_header(self, msg: DeltaHeaderMsg, peer: str):

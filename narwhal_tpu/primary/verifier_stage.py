@@ -18,7 +18,7 @@ import logging
 
 from ..channels import Channel
 from ..config import Committee, WorkerCache
-from ..types import Certificate, DagError, Header, Vote
+from ..types import Certificate, DagError, Header, InvalidEpoch, Vote
 
 logger = logging.getLogger("narwhal.primary")
 
@@ -101,6 +101,15 @@ class VerifierStage:
             else:
                 await self.tx_out.send(msg)
                 return
+        except InvalidEpoch:
+            # NOT this stage's call: the Core buffers exactly-one-epoch-ahead
+            # messages for replay after its reconfigure notification lands
+            # (the epoch-change deadlock fix) and logs the stale drops.
+            # Forward RAW (un-preverified): the Core re-runs the full
+            # sanitize path — including signatures, against whatever
+            # committee it holds when the message is finally handled.
+            await self.tx_out.send(msg)
+            return
         except DagError as e:
             logger.debug("verifier stage dropped malformed message: %s", e)
             return

@@ -72,6 +72,10 @@ class ScenarioResult:
     wall_s: float
     byzantine: tuple = ()
     crashed: tuple = ()
+    # Certificate wire forms accumulated in each alive node's store
+    # ({"compact": n, "full": n} per node): scenario tests pin that a
+    # compact-committee run really exercised the half-aggregated form.
+    cert_forms: list = field(default_factory=list)
     log_entries: list = field(default_factory=list, repr=False)
 
     def honest(self) -> list[int]:
@@ -337,6 +341,13 @@ async def _drive(
     #    deterministic contract) -------------------------------------------
     mark("end")
     rounds = cluster.committed_rounds()
+    cert_forms = []
+    for a in cluster.authorities:
+        forms = {"compact": 0, "full": 0}
+        if a.primary is not None:
+            for cert in a.primary.storage.certificate_store.after_round(1):
+                forms["compact" if cert.is_compact else "full"] += 1
+        cert_forms.append(forms)
     wire1 = WireStats.snapshot()
     log_digest = fabric.log.digest()
     log_len = len(fabric.log)
@@ -393,6 +404,7 @@ async def _drive(
         wall_s=0.0,
         byzantine=tuple(sorted(byzantine)),
         crashed=tuple(sorted(crashed)),
+        cert_forms=cert_forms,
         log_entries=list(fabric.log.entries) if keep_log else [],
     )
 

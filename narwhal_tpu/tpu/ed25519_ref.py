@@ -80,12 +80,21 @@ def affine(p) -> tuple[int, int]:
 def recover_x(y: int, sign: int) -> int | None:
     if y >= P:
         return None
-    x2 = (y * y - 1) * fe_inv(D * y * y + 1) % P
-    x = pow(x2, (P + 3) // 8, P)
-    if (x * x - x2) % P != 0:
+    # RFC 8032 §5.1.3 single-exponentiation form: the candidate root of
+    # x^2 = u/v is x = u v^3 (u v^7)^((P-5)/8) — identical to
+    # (u/v)^((P+3)/8) (exponents differ by a multiple of P-1) without the
+    # separate field inversion, halving the cost of every decompression
+    # (one ~255-bit pow instead of two; decompression is the floor of the
+    # host batched certificate-proof verifier).
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    v3 = v * v % P * v % P
+    x = u * v3 % P * pow(u * v3 % P * v3 % P * v % P, (P - 5) // 8, P) % P
+    vx2 = v * x % P * x % P
+    if vx2 != u:
+        if vx2 != P - u:
+            return None
         x = x * SQRT_M1 % P
-    if (x * x - x2) % P != 0:
-        return None
     if x == 0 and sign:
         return None
     if x & 1 != sign:

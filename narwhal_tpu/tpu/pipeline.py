@@ -62,26 +62,51 @@ class FusedCertificatePipeline:
     def feed(self, certs: Sequence[Certificate], committee=None) -> None:
         """Pack + dispatch one verify batch; resolves the oldest in-flight
         batch first when the pipeline is full, so at most `depth` batches
-        ride the device at once."""
+        ride the device at once. Full-format certificates dispatch their
+        per-vote signature items; compact certificates ride the verifier's
+        aggregate group lane (submit_groups — the default dispatch shape
+        now that compact is the committee-wide default), both halves of one
+        batch in flight together."""
         while len(self._inflight) >= self.depth:
             self._resolve_one()
         committee = committee or self.engine.committee
         items: list = []
-        spans: list[tuple[Certificate, int, int]] = []
+        groups: list = []
+        # Input order preserved: ("item", cert, lo, hi) spans index into the
+        # item verdicts, ("group", cert, g) into the group verdicts; g/lo of
+        # None marks a signature-free certificate (genesis): valid.
+        spans: list[tuple] = []
         for cert in certs:
-            cert_items = cert.verify_items(committee)
-            spans.append((cert, len(items), len(items) + len(cert_items)))
-            items.extend(cert_items)
+            if cert.is_compact:
+                group = cert.aggregate_group(committee)
+                if group is None:
+                    spans.append(("group", cert, None))
+                else:
+                    spans.append(("group", cert, len(groups)))
+                    groups.append(group)
+            else:
+                cert_items = cert.verify_items(committee)
+                spans.append(("item", cert, len(items), len(items) + len(cert_items)))
+                items.extend(cert_items)
         handle = self.verifier.submit(items)
-        self._inflight.append((spans, handle))
+        ghandle = self.verifier.submit_groups(groups) if groups else None
+        self._inflight.append((spans, handle, ghandle))
 
     def _resolve_one(self) -> None:
-        spans, handle = self._inflight.popleft()
+        spans, handle, ghandle = self._inflight.popleft()
         ok = self.verifier.collect(handle)
+        gok = self.verifier.collect_groups(ghandle) if ghandle is not None else []
         accepted: list[Certificate] = []
-        for cert, lo, hi in spans:
-            # Genesis certificates carry no signatures (empty span): valid.
-            if all(ok[lo:hi]):
+        for span in spans:
+            if span[0] == "group":
+                _, cert, g = span
+                passed = True if g is None else gok[g]
+            else:
+                _, cert, lo, hi = span
+                # Genesis certificates carry no signatures (empty span):
+                # valid.
+                passed = all(ok[lo:hi])
+            if passed:
                 accepted.append(cert)
             else:
                 self.rejected.append(cert)

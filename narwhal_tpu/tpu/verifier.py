@@ -1129,8 +1129,11 @@ class AsyncVerifierPool:
         backend=None,
         max_batch: int = 512,
         max_delay: float = 0.002,
+        group_backend=None,
+        max_groups: int = 64,
     ):
         from .. import crypto
+        from ..types import host_batch_verify_aggregates
 
         self.backend = backend or crypto.batch_verify
         self.max_batch = max_batch
@@ -1138,6 +1141,15 @@ class AsyncVerifierPool:
         self._pending: list[tuple[BatchItem, asyncio.Future]] = []
         self._flusher: asyncio.Task | None = None
         self._batches: set[asyncio.Task] = set()  # strong refs: loop holds weak
+        # Aggregate-certificate group lane (compact certs): concurrent
+        # verify_aggregate calls coalesce under the same seal rule and
+        # dispatch as ONE host_batch_verify_aggregates call — one
+        # bucket-method MSM amortized across every certificate in the
+        # flush, the host analog of VerifyService's device group lane.
+        self.group_backend = group_backend or host_batch_verify_aggregates
+        self.max_groups = max_groups
+        self._pending_groups: list[tuple[tuple, asyncio.Future]] = []
+        self._group_flusher: asyncio.Task | None = None
 
     async def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         loop = asyncio.get_running_loop()
@@ -1175,21 +1187,56 @@ class AsyncVerifierPool:
                 fut.set_result(res)
 
     async def verify_aggregate(self, items, zs, s_agg: int) -> bool:
-        """Half-aggregated certificate proof check on the host (pure
-        Python — slow; the device-backed VerifyService is the production
-        lane for compact committees)."""
-        from ..types import host_verify_aggregate
-
+        """Half-aggregated certificate proof check (compact certs), batched:
+        groups queued by concurrent callers — the verifier stage's
+        per-message tasks, the block synchronizer's catch-up fetches —
+        seal into one `host_batch_verify_aggregates` dispatch (size- or
+        deadline-triggered, like the item lane), so many certificates
+        share one randomized-linear-combination MSM instead of paying a
+        per-certificate scalar-mul walk."""
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
-            None, host_verify_aggregate, items, zs, s_agg
-        )
+        fut: asyncio.Future = loop.create_future()
+        self._pending_groups.append(((items, zs, s_agg), fut))
+        if len(self._pending_groups) >= self.max_groups:
+            self._flush_groups_now()
+        elif self._group_flusher is None or self._group_flusher.done():
+            self._group_flusher = asyncio.ensure_future(self._deadline_flush_groups())
+        return await fut
+
+    def _flush_groups_now(self) -> None:
+        pending, self._pending_groups = self._pending_groups, []
+        if pending:
+            task = asyncio.ensure_future(self._run_group_batch(pending))
+            self._batches.add(task)
+            task.add_done_callback(self._batches.discard)
+
+    async def _deadline_flush_groups(self) -> None:
+        await asyncio.sleep(self.max_delay)
+        self._flush_groups_now()
+
+    async def _run_group_batch(self, pending) -> None:
+        groups = [group for group, _ in pending]
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(None, self.group_backend, groups)
+        except Exception as e:
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        for (_, fut), res in zip(pending, results):
+            if not fut.done():
+                fut.set_result(res)
 
     async def close(self) -> None:
         if self._flusher is not None:
             self._flusher.cancel()
             self._flusher = None
+        if self._group_flusher is not None:
+            self._group_flusher.cancel()
+            self._group_flusher = None
         self._flush_now()
+        self._flush_groups_now()
         # In-flight batch dispatches resolve their callers' futures; give
         # them a bounded window to finish, then cancel stragglers so no
         # batch task survives its owner (a wedged executor thread must not

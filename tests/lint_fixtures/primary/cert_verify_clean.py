@@ -1,0 +1,26 @@
+"""Clean fixture for no-per-item-cert-verify: batched-API call shapes and
+non-certificate receivers that must never match."""
+
+from narwhal_tpu.types import host_batch_verify_aggregates
+
+
+async def staged(msg, pool, committee, worker_cache):
+    # Structural half inline, signatures batched — the verifier-stage shape.
+    msg.header.verify(committee, worker_cache, check_signature=False)
+    group = msg.aggregate_group(committee)
+    return await pool.verify_aggregate(*group)
+
+
+async def headers_and_votes(header, vote, committee, worker_cache):
+    # Per-item header/vote checks are NOT certificate checks.
+    header.verify(committee, worker_cache)
+    vote.verify(committee)
+
+
+def batched(groups):
+    return host_batch_verify_aggregates(groups)
+
+
+def structural_only(certificate, committee):
+    # Structural/stake checks carry no signature work.
+    certificate.structural_verify(committee)

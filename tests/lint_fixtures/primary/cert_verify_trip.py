@@ -1,0 +1,19 @@
+"""Tripping fixture for no-per-item-cert-verify: three per-certificate
+verification shapes the batched verifier API replaces (pinned count 3)."""
+
+from narwhal_tpu.types import host_verify_aggregate
+
+
+async def handle(certificate, committee, worker_cache):
+    # 1: the classic inline per-certificate check.
+    certificate.verify(committee, worker_cache)
+
+
+async def fetch(cert, committee, worker_cache):
+    # 2: abbreviated receiver name still a certificate.
+    cert.verify(committee, worker_cache)
+
+
+def check_proof(items, zs, s_agg):
+    # 3: raw per-group host walk instead of the batched MSM.
+    return host_verify_aggregate(items, zs, s_agg)

@@ -289,14 +289,15 @@ def test_wire_accounting_metrics_consistent(run):
                 for a in cluster.authorities
             ]
             # Nonzero on every node: headers go out (delta wire form by
-            # default), votes flow both ways.
+            # default), votes flow both ways (slim Vote2Msg by default,
+            # full VoteMsg still accepted).
             for s, r in zip(sent, recv):
                 assert s.get("DeltaHeaderMsg", 0) + s.get("HeaderMsg", 0) > 0
-                assert s.get("VoteMsg", 0) > 0
-                assert r.get("VoteMsg", 0) > 0
+                assert s.get("VoteMsg", 0) + s.get("Vote2Msg", 0) > 0
+                assert r.get("VoteMsg", 0) + r.get("Vote2Msg", 0) > 0
             # Consistency: closed committee — for primary-plane types the
             # aggregate received bytes cannot exceed aggregate sent bytes.
-            for msg_type in ("DeltaHeaderMsg", "HeaderMsg", "VoteMsg"):
+            for msg_type in ("DeltaHeaderMsg", "HeaderMsg", "VoteMsg", "Vote2Msg"):
                 total_sent = sum(s.get(msg_type, 0) for s in sent)
                 total_recv = sum(r.get(msg_type, 0) for r in recv)
                 assert total_recv <= total_sent
@@ -307,3 +308,111 @@ def test_wire_accounting_metrics_consistent(run):
             await cluster.shutdown()
 
     run(scenario(), timeout=120.0)
+
+
+def test_relay2_slim_codec_roundtrips_byte_exact():
+    """encode_relay2/decode_relay2: the slim bodies reconstitute the EXACT
+    fat announcement (bitmap signers/parents, envelope-deduped fields), the
+    generic kind carries anything else verbatim, and out-of-range values
+    refuse to encode slim (caller falls back to the legacy envelope)."""
+    from narwhal_tpu.fixtures import CommitteeFixture
+    from narwhal_tpu.messages import (
+        CertificateRefMsg,
+        DeltaHeaderMsg,
+        HeaderMsg,
+        Relay2Msg,
+    )
+    from narwhal_tpu.primary.fanout import (
+        R2_CERT_REF,
+        R2_DELTA_HEADER,
+        R2_GENERIC,
+        decode_relay2,
+        encode_relay2,
+    )
+    from narwhal_tpu.types import Certificate, Header, Vote
+
+    fx = CommitteeFixture(size=7)
+    committee = fx.committee
+    origin = fx.authorities[2]
+    h = Header.build(
+        origin.public, 5, 0, {b"\x0a" * 32: 3},
+        frozenset(c.digest for c in Certificate.genesis(committee)),
+        origin.signature_service(),
+    )
+    votes = [
+        Vote.for_header(h, a.public, a.signature_service())
+        for a in fx.authorities[:5]
+    ]
+    signers, sigs = zip(
+        *sorted((committee.index_of(v.author), v.signature) for v in votes)
+    )
+    cert = Certificate.compact_from_votes(h, tuple(signers), tuple(sigs))
+
+    ref = CertificateRefMsg.from_certificate(cert)
+    slim = encode_relay2(committee, origin.public, cert.round, ref)
+    assert slim is not None and slim.kind == R2_CERT_REF
+    back = decode_relay2(committee, slim)
+    assert back == ref
+    assert back.rebuild(h).to_bytes() == cert.to_bytes()
+
+    delta = DeltaHeaderMsg(
+        origin.public, 5, 0, h.digest, tuple(h.payload.items()),
+        (0, 1, 4, 6), h.signature,
+    )
+    slim_h = encode_relay2(committee, origin.public, 5, delta)
+    assert slim_h is not None and slim_h.kind == R2_DELTA_HEADER
+    assert decode_relay2(committee, slim_h) == delta
+
+    # Anything the slim kinds cannot express rides the generic kind
+    # verbatim.
+    full = HeaderMsg(h)
+    slim_g = encode_relay2(committee, origin.public, 5, full)
+    assert slim_g is not None and slim_g.kind == R2_GENERIC
+    assert decode_relay2(committee, slim_g).header.to_bytes() == h.to_bytes()
+
+    # Out-of-slim-range rounds refuse (legacy RelayMsg covers them).
+    assert encode_relay2(committee, origin.public, 1 << 33, ref) is None
+
+    # Malformed envelopes are rejected, never mis-decoded.
+    import pytest as _pytest
+
+    bad = Relay2Msg(999, 5, 0, R2_CERT_REF, slim.body)
+    with _pytest.raises(ValueError):
+        decode_relay2(committee, bad)
+
+
+def test_oneway_frames_dispatch_without_response(run):
+    """KIND_ONEWAY: the handler runs, no response frame comes back, and the
+    connection stays healthy for normal request/response traffic after."""
+    import asyncio
+
+    from narwhal_tpu.messages import CleanupMsg
+    from narwhal_tpu.network import NetworkClient, RpcServer
+
+    async def scenario():
+        got = []
+        srv = RpcServer()
+
+        async def on_cleanup(msg, peer):
+            got.append(msg.round)
+            return None
+
+        srv.route(CleanupMsg, on_cleanup)
+        port = await srv.start("127.0.0.1", 0)
+        client = NetworkClient()
+        try:
+            addr = f"127.0.0.1:{port}"
+            assert await client.oneway_send(addr, CleanupMsg(7))
+            for _ in range(50):
+                if got:
+                    break
+                await asyncio.sleep(0.05)
+            assert got == [7]
+            # The same connection still serves acked requests.
+            assert await client.unreliable_send(addr, CleanupMsg(9))
+            assert sorted(got) == [7, 9]
+        finally:
+            client.close()
+            await srv.stop()
+
+    run(scenario(), timeout=30.0)

@@ -121,6 +121,9 @@ def _golden_messages():
         M.HeaderResyncRequest: M.HeaderResyncRequest(d1, pk, 1, pk),
         M.HeaderResyncResponse: M.HeaderResyncResponse((header,)),
         M.CertificateDeltaMsg: M.CertificateDeltaMsg.from_certificate(cert),
+        M.Relay2Msg: M.Relay2Msg(1, 3, 0, 2, b"\x66" * 16),
+        M.RelayAck2Msg: M.RelayAck2Msg(d1, 2),
+        M.Vote2Msg: M.Vote2Msg.from_vote(vote),
     }
 
 

@@ -94,6 +94,7 @@ EXPECTED_RULES = {
     "no-unbounded-channel",
     "no-wall-clock-in-actors",
     "no-untracked-jit",
+    "no-per-item-cert-verify",
 }
 
 FIXTURE_FOR = {
@@ -128,6 +129,10 @@ FIXTURE_FOR = {
     "no-untracked-jit": (
         "tpu/untracked_jit_trip.py",
         "tpu/untracked_jit_clean.py",
+    ),
+    "no-per-item-cert-verify": (
+        "primary/cert_verify_trip.py",
+        "primary/cert_verify_clean.py",
     ),
 }
 
@@ -173,6 +178,8 @@ def test_fixture_finding_counts():
         "no-wall-clock-in-actors": 5,
         # raw @jax.jit decorator, partial(jax.jit, ...) form, jax.jit(f) call
         "no-untracked-jit": 3,
+        # certificate.verify, cert.verify, raw host_verify_aggregate
+        "no-per-item-cert-verify": 3,
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -1058,3 +1058,70 @@ class NoUntrackedJit(Rule):
                         "(kernel, mesh shape) per process), module-level "
                         "kernels from `@kernel_registry.tracked_jit`",
                     )
+
+
+# ---------------------------------------------------------------------------
+# no-per-item-cert-verify
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoPerItemCertVerify(Rule):
+    name = "no-per-item-cert-verify"
+    summary = (
+        "in primary/ and consensus/, a Certificate.verify (or raw "
+        "host_verify_aggregate) call site runs per-certificate host crypto "
+        "inline; certificates must ride the batched verifier API — the "
+        "crypto pool's verify/verify_aggregate lanes or "
+        "types.host_batch_verify_aggregates — so signature work amortizes "
+        "one device dispatch / one bucket-method MSM per flush. The "
+        "documented terminal fallbacks (no pool configured) carry a "
+        "justified `# lint: allow(no-per-item-cert-verify)`"
+    )
+
+    _SCOPED_DIRS = frozenset({"primary", "consensus"})
+    # Receiver-name heuristic for certificate-shaped objects; header.verify
+    # and vote.verify never match (their per-item checks ARE the batched
+    # stage's structural half).
+    _CERT_METHODS = {"verify"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, aliases)
+            if target is not None and (
+                target == "host_verify_aggregate"
+                or target.endswith(".host_verify_aggregate")
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    "`host_verify_aggregate(...)` is the per-certificate "
+                    "naive reference — dispatch proof groups through "
+                    "`host_batch_verify_aggregates` (or the crypto pool's "
+                    "verify_aggregate lane) so one MSM serves the flush",
+                )
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._CERT_METHODS
+            ):
+                continue
+            recv = dotted(node.func.value)
+            # Only the FINAL segment names the receiver: `cert.verify` is a
+            # certificate check, `cert.header.verify` is the header's.
+            if recv is None or "cert" not in recv.split(".")[-1].lower():
+                continue
+            yield self.finding(
+                mod,
+                node,
+                f"`{recv}.{node.func.attr}(...)` verifies one certificate "
+                "inline on the host — route it through the batched "
+                "verifier API (verifier stage / crypto pool "
+                "verify_aggregate), or justify a documented no-pool "
+                "fallback with `# lint: allow(no-per-item-cert-verify)`",
+            )
