@@ -192,6 +192,17 @@ async def run_bench(args) -> dict:
     for d in drains:
         d.cancel()
     client.close()
+    # Embed node 0's scrape (counters/gauges + histogram sums) so the
+    # results record is self-contained: any later A/B can recompute stage
+    # means and wire rates without rerunning the bench.
+    from narwhal_tpu.metrics import scrape_snapshot
+
+    telemetry = {
+        "primary-0": scrape_snapshot(cluster.authorities[0].primary.registry),
+        "worker-0-0": scrape_snapshot(
+            cluster.authorities[0].workers[0].registry
+        ),
+    }
     await cluster.shutdown()
 
     tps = executed[0] / window if executed[0] else 0.0
@@ -268,6 +279,9 @@ async def run_bench(args) -> dict:
         ),
         "pacing": os.environ.get("NARWHAL_PACING", "1") not in ("0", "false", "off"),
         "ingest_policy": os.environ.get("NARWHAL_INGEST_POLICY", "shed"),
+        "trace": os.environ.get("NARWHAL_TRACE", "0"),
+        "trace_sample": os.environ.get("NARWHAL_TRACE_SAMPLE", "1.0"),
+        "telemetry_scrape": telemetry,
     }
 
 

@@ -124,14 +124,29 @@ async def run_liveness(args) -> dict:
         wire1 = WireStats.snapshot()
         egress1 = primary_sent_by_type()
         rounds1 = committed()
+        telemetry = _scrape_node0(cluster)
         await cluster.shutdown()
 
     window = time.time() - t_start
-    return _record(
+    record = _record(
         args, "in-process liveness", boot_s, samples, window,
         rounds0, rounds1, wire0, wire1, egress0, egress1,
         alive=args.nodes - args.faults,
     )
+    record["telemetry_scrape"] = telemetry
+    return record
+
+
+def _scrape_node0(cluster) -> dict:
+    """Node 0's parsed scrape (buckets dropped) for the results record —
+    the same surface Telemetry.Scrape serves over RPC, captured in-process
+    because the committee lives in this process anyway."""
+    from narwhal_tpu.metrics import scrape_snapshot
+
+    for a in cluster.authorities:
+        if a.primary is not None:
+            return {"primary-0": scrape_snapshot(a.primary.registry)}
+    return {}
 
 
 def run_liveness_simnet(args) -> dict:
@@ -218,12 +233,14 @@ def run_liveness_simnet(args) -> dict:
         wire1 = WireStats.snapshot()
         egress1 = primary_sent_by_type()
         rounds1 = committed()
+        telemetry = _scrape_node0(cluster)
         await cluster.shutdown()
         record = _record(
             args, "simnet liveness (virtual clock)", boot_s, samples, window,
             rounds0, rounds1, wire0, wire1, egress0, egress1,
             alive=args.nodes - args.faults,
         )
+        record["telemetry_scrape"] = telemetry
         record["virtual_duration_s"] = round(window, 1)
         record["wall_s"] = round(time.time() - t_wall, 1)
         record["real_sockets"] = 0

@@ -69,6 +69,9 @@ class LocalBench:
             )
         self.base = os.path.abspath(".bench")
         self.procs: list[subprocess.Popen] = []
+        # Per-primary Telemetry.Scrape snapshots from the last run()
+        # (gRPC, taken just before teardown; sweep.py embeds them).
+        self.telemetry_scrapes: dict[str, dict] = {}
 
     # -- config generation (local.py + config.py of the reference) ---------
 
@@ -184,6 +187,50 @@ class LocalBench:
                 p.kill()
         self.procs.clear()
 
+    def _scrape_primaries(self, alive: int) -> dict:
+        """Scrape each primary subprocess's gRPC Telemetry service (the
+        raw-bytes mirror any process can hit) before teardown, keyed by
+        node index. The bound address is ephemeral, so it is read from the
+        node's own boot log line. Best-effort: a bench record is still
+        valid without its scrape."""
+        import re
+
+        from narwhal_tpu.metrics import parse_exposition
+
+        try:
+            import grpc
+        except ImportError:
+            return {}
+        scrapes: dict[str, dict] = {}
+        for i in range(alive):
+            try:
+                with open(f"{self.base}/primary-{i}.log") as fh:
+                    m = re.search(
+                        r"gRPC public API listening on (\S+)", fh.read()
+                    )
+                if m is None:
+                    continue
+                with grpc.insecure_channel(m.group(1)) as channel:
+                    text = channel.unary_unary(
+                        "/narwhal.Telemetry/Scrape",
+                        request_serializer=lambda b: b,
+                        response_deserializer=lambda b: b,
+                    )(b"", timeout=10).decode()
+                scrapes[f"primary-{i}"] = {
+                    name: {
+                        "type": entry["type"],
+                        "samples": {
+                            k: v
+                            for k, v in entry["samples"].items()
+                            if not k.startswith("_bucket")
+                        },
+                    }
+                    for name, entry in parse_exposition(text).items()
+                }
+            except Exception as e:  # scrape is diagnostics, never the bench
+                print(f"telemetry scrape of primary-{i} failed: {e}")
+        return scrapes
+
     def run(self, debug: bool = False) -> LogParser:
         bench = self.bench
         committee, workers = self._generate_configs()
@@ -237,6 +284,9 @@ class LocalBench:
                     f"{self.base}/client-{j}.log",
                 )
             time.sleep(bench.duration)
+            # Scrape-then-kill: the telemetry surface is only reachable
+            # while the fleet is alive (sweep.py embeds this in its rows).
+            self.telemetry_scrapes = self._scrape_primaries(alive)
         finally:
             self._kill_all()
         return LogParser.process(

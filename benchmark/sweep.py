@@ -58,6 +58,13 @@ def run_once(rate: int, args) -> dict:
     record["faults"] = args.faults
     record["cert_format"] = args.cert_format
     record["verify_rule"] = args.verify_rule
+    # Node 0's Telemetry.Scrape (gRPC, taken while the fleet was alive):
+    # counters/gauges + histogram sums embedded so each sweep row is
+    # self-contained for later A/Bs; other nodes' scrapes stay out to keep
+    # rows bounded.
+    record["telemetry_scrape"] = {
+        "primary-0": bench.telemetry_scrapes.get("primary-0", {})
+    }
     print(
         f"  rate {rate:>8,}: TPS {record['consensus_tps']:>10,.0f}  "
         f"lat {record['consensus_latency_ms']:>8,.0f} ms  "

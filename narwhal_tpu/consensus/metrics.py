@@ -7,7 +7,8 @@ from ..pacing import StageTimer
 
 
 class ConsensusMetrics:
-    def __init__(self, registry: Registry):
+    def __init__(self, registry: Registry, tracer=None):
+        self.tracer = tracer
         # -- stage tracing --------------------------------------------------
         self.stage_latency = registry.histogram(
             "consensus_stage_latency_seconds",
@@ -18,7 +19,7 @@ class ConsensusMetrics:
         )
         # Bounded: certificates that never commit (GC'd past the window)
         # age out of the pending map instead of leaking.
-        self.commit_timer = StageTimer(self.stage_latency, "commit")
+        self.commit_timer = StageTimer(self.stage_latency, "commit", tracer=tracer)
         self.last_committed_round = registry.gauge(
             "consensus_last_committed_round", "The last committed leader round"
         )

@@ -73,8 +73,13 @@ class Executor:
         rx_accepted: Channel | None = None,  # accepted-certificate tap
         gc_depth: int = 50,
         prefetch_budget: int | None = None,  # bytes; 0/None w/o tap disables
+        tracer=None,
     ):
-        metrics = ExecutorMetrics(registry) if registry is not None else None
+        metrics = (
+            ExecutorMetrics(registry, tracer=tracer)
+            if registry is not None
+            else None
+        )
         # Staged-payload hand-off (subscriber -> core), depth-gauged like
         # every other inter-actor edge: its occupancy is one of the signals
         # the node's backpressure monitor folds into the admission level.

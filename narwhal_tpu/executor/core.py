@@ -19,7 +19,6 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..clock import now
 from ..channels import Channel
 from ..stores import BatchStore
 from ..types import Batch, ConsensusOutput
@@ -85,9 +84,13 @@ class ExecutorCore:
                 output, batches, t_commit = await self.rx_subscriber.recv()
                 await self.execute_certificate(output, batches)
                 if self.metrics is not None and t_commit is not None:
-                    dt = now() - t_commit
+                    # Span-unified close: one call emits both the execute
+                    # stage histogram sample and (when tracing) the span
+                    # terminating this certificate's waterfall.
+                    dt = self.metrics.execute_timer.close(
+                        output.certificate.digest, t_commit
+                    )
                     self.metrics.commit_to_exec_latency.observe(dt)
-                    self.metrics.stage_latency.labels("execute").observe(dt)
         except asyncio.CancelledError:
             raise
         except Exception:

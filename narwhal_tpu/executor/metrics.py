@@ -17,7 +17,8 @@ _RPC_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 
 
 class ExecutorMetrics:
-    def __init__(self, registry: Registry):
+    def __init__(self, registry: Registry, tracer=None):
+        self.tracer = tracer
         self.executed_transactions = registry.counter(
             "executor_executed_transactions",
             "Transactions applied to the execution state",
@@ -70,4 +71,11 @@ class ExecutorMetrics:
             "Per-stage pipeline latency in the executor (stage=execute: "
             "ordered certificate emitted -> payload fully applied)",
             labels=("stage",),
+        )
+        # Span-unified close site for the execute stage, keyed by the
+        # committed certificate digest (the waterfall's terminal edge).
+        from ..pacing import StageTimer
+
+        self.execute_timer = StageTimer(
+            self.stage_latency, "execute", tracer=tracer
         )

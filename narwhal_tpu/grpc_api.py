@@ -39,6 +39,19 @@ def _unary(handler, request_cls):
     )
 
 
+def _raw_unary(handler):
+    """Unary method whose request/response are raw bytes end to end — the
+    telemetry plane's scrape text and flight-recorder JSON need no protoc
+    message types, matching the raw-bytes generic-handler idiom above."""
+
+    async def call(request_bytes, context):
+        return await handler(request_bytes, context)
+
+    return grpc.unary_unary_rpc_method_handler(
+        call, request_deserializer=None, response_serializer=None
+    )
+
+
 def _stream_in(handler, request_cls):
     async def call(request_iter, context):
         async def typed():
@@ -78,6 +91,8 @@ class GrpcPublicApi:
         block_remover,
         dag=None,
         primary_address: str = "",
+        registry=None,  # metrics.Registry: Telemetry.Scrape source
+        tracer=None,  # tracing.Tracer: Telemetry.DumpFlightRecorder source
     ):
         self.name = name
         self.committee = committee
@@ -85,6 +100,8 @@ class GrpcPublicApi:
         self.block_remover = block_remover
         self.dag = dag
         self.primary_address = primary_address
+        self.registry = registry
+        self.tracer = tracer
         self._server: grpc.aio.Server | None = None
         self.address: str = ""
 
@@ -192,6 +209,31 @@ class GrpcPublicApi:
     async def _get_primary_address(self, request, context):
         return pb.GetPrimaryAddressResponse(primary_address=self.primary_address)
 
+    # -- Telemetry ---------------------------------------------------------
+    async def _scrape(self, request_bytes, context):
+        if self.registry is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "Telemetry.Scrape: node mounted no registry",
+            )
+        return self.registry.render().encode()
+
+    async def _dump_flight(self, request_bytes, context):
+        import json
+
+        if self.tracer is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "Telemetry.DumpFlightRecorder: node mounted no tracer",
+            )
+        # Request body: optional 4-byte little-endian max_events cap.
+        max_events = None
+        if len(request_bytes) >= 4:
+            cap = int.from_bytes(request_bytes[:4], "little")
+            max_events = cap or None
+        dump = self.tracer.dump(max_events)
+        return json.dumps(dump, sort_keys=True, separators=(",", ":")).encode()
+
     # -- lifecycle ---------------------------------------------------------
     def _services(self) -> list[_Service]:
         return [
@@ -224,6 +266,13 @@ class GrpcPublicApi:
                         self._new_network_info, pb.NewNetworkInfoRequest
                     ),
                     "GetPrimaryAddress": _unary(self._get_primary_address, pb.Empty),
+                },
+            ),
+            _Service(
+                "Telemetry",
+                {
+                    "Scrape": _raw_unary(self._scrape),
+                    "DumpFlightRecorder": _raw_unary(self._dump_flight),
                 },
             ),
         ]

@@ -688,6 +688,73 @@ class RelayAck2Msg:
 
 
 # ---------------------------------------------------------------------------
+# Telemetry plane: scrape + flight-recorder dump over the public typed API
+# (ConsensusApi routes these, so they're fabric-reachable under simnet; the
+# gRPC edge exposes the same payloads for interop scrapers).
+# ---------------------------------------------------------------------------
+
+
+@message(82)
+@dataclass
+class TelemetryScrapeMsg:
+    """Request the node's Prometheus text exposition."""
+
+    def encode(self, w: Writer) -> None:
+        pass
+
+    @staticmethod
+    def decode(r: Reader) -> "TelemetryScrapeMsg":
+        return TelemetryScrapeMsg()
+
+
+@message(83)
+@dataclass
+class TelemetryScrapeResponse:
+    """Prometheus exposition-format text (# HELP/# TYPE + samples)."""
+
+    text: str
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.text.encode())
+
+    @staticmethod
+    def decode(r: Reader) -> "TelemetryScrapeResponse":
+        return TelemetryScrapeResponse(r.bytes().decode())
+
+
+@message(84)
+@dataclass
+class FlightDumpMsg:
+    """Request the node's flight-recorder dump (bounded structured event
+    ring + span edges). max_events=0 means the full ring."""
+
+    max_events: int = 0
+
+    def encode(self, w: Writer) -> None:
+        w.u32(self.max_events)
+
+    @staticmethod
+    def decode(r: Reader) -> "FlightDumpMsg":
+        return FlightDumpMsg(r.u32())
+
+
+@message(85)
+@dataclass
+class FlightDumpResponse:
+    """Self-contained JSON flight-recorder dump (tracing.Tracer.dump),
+    sort_keys-canonical so dumps diff and snapshot deterministically."""
+
+    payload: bytes
+
+    def encode(self, w: Writer) -> None:
+        w.bytes(self.payload)
+
+    @staticmethod
+    def decode(r: Reader) -> "FlightDumpResponse":
+        return FlightDumpResponse(r.bytes())
+
+
+# ---------------------------------------------------------------------------
 # Primary -> Worker (types/src/primary.rs:702-750)
 # ---------------------------------------------------------------------------
 

@@ -207,6 +207,69 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Parse Prometheus text exposition (the exact dialect render() emits)
+    into {name: {"type", "help", "samples": {label_suffix: value}}}. The
+    label_suffix key is the raw '{...}' chunk ('' for unlabelled samples),
+    so round-tripping a scrape is lossless for assertions and benchmark
+    snapshots; _bucket/_sum/_count series fold under their base name."""
+    out: dict[str, dict] = {}
+
+    def entry(name: str) -> dict:
+        return out.setdefault(
+            name, {"type": "untyped", "help": "", "samples": {}}
+        )
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_ = line[len("# HELP "):].partition(" ")
+            entry(name)["help"] = help_
+            continue
+        if line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            entry(name)["type"] = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        series, _, raw_value = line.rpartition(" ")
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        name, labels = series, ""
+        if "{" in series:
+            name, _, rest = series.partition("{")
+            labels = "{" + rest
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in out:
+                base = name[: -len(suffix)]
+                labels = name[len(base):] + labels
+                break
+        entry(base)["samples"][labels] = value
+    return out
+
+
+def scrape_snapshot(registry: Registry) -> dict[str, dict]:
+    """Benchmark-sized scrape snapshot: the full exposition parsed back,
+    minus histogram bucket series (they dominate the byte count and the
+    percentile story belongs to the trace-waterfall artifacts). Counters,
+    gauges, and histogram _sum/_count survive — enough for any A/B to
+    recompute rates and means from the embedded record alone."""
+    out = {}
+    for name, entry in parse_exposition(registry.render()).items():
+        samples = {
+            k: v
+            for k, v in entry["samples"].items()
+            if not k.startswith("_bucket")
+        }
+        out[name] = {"type": entry["type"], "samples": samples}
+    return out
+
+
 async def serve_metrics(registry: Registry, host: str, port: int):
     """Minimal HTTP /metrics exporter (node/src/main.rs:279-285). Returns the
     asyncio server; the bound port is server.sockets[0].getsockname()[1]."""

@@ -32,6 +32,7 @@ from .primary.block_remover import BlockRemover
 from .primary.block_synchronizer import BlockSynchronizer
 from .primary.block_waiter import BlockWaiter
 from .stores import NodeStorage
+from .tracing import Tracer
 from .types import ConsensusOutput, PublicKey
 from .worker import Worker
 
@@ -94,8 +95,22 @@ class PrimaryNode:
         self.storage = storage
         self.registry = registry or Registry()
         self.internal_consensus = internal_consensus
+        # One tracer + flight recorder per node, shared by every role-level
+        # metrics object (worker seal spans live on the WorkerNode's own
+        # tracer): span emission is keyed on the same causal digests on
+        # every node, so cross-stage waterfalls stitch without new wire
+        # bytes. Off (zero-overhead ring of instants only) unless
+        # NARWHAL_TRACE=1.
+        self.tracer = Tracer(node=f"primary-{self.name.hex()[:8]}")
         # Group-commit instruments (fused-WAL group size / flush latency).
         storage.engine.attach_metrics(self.registry)
+        # Registered at assembly (not inside the monitor coroutine) so the
+        # metrics catalog extractor sees the full surface without spawning.
+        self._backpressure_gauge = self.registry.gauge(
+            "node_backpressure_level",
+            "Downstream backlog level pushed to our workers (max of channel "
+            "occupancy, commit-latency-vs-target, and commit-stall signals)",
+        )
 
         # Channels between the three subsystems (node/src/lib.rs:150-192),
         # depth-gauged like the reference's porcelain metrics (lib.rs:168-192).
@@ -257,6 +272,7 @@ class PrimaryNode:
             registry=self.registry,
             crypto_pool=crypto_pool,
             network_keypair=network_keypair,
+            tracer=self.tracer,
         )
 
         self.consensus: Consensus | None = None
@@ -321,7 +337,9 @@ class PrimaryNode:
                 protocol = protocol_cls(
                     committee, storage.consensus_store, parameters.gc_depth
                 )
-            self.consensus_metrics = ConsensusMetrics(self.registry)
+            self.consensus_metrics = ConsensusMetrics(
+                self.registry, tracer=self.tracer
+            )
             self.consensus = Consensus(
                 committee,
                 protocol,
@@ -348,6 +366,7 @@ class PrimaryNode:
                 rx_accepted=self.tx_accepted_certificates,
                 gc_depth=parameters.gc_depth,
                 prefetch_budget=prefetch_budget,
+                tracer=self.tracer,
             )
         else:
             # External consensus: the Dag service consumes the certificate
@@ -398,6 +417,8 @@ class PrimaryNode:
             self.block_waiter,
             self.block_remover,
             dag=self.dag,
+            registry=self.registry,
+            tracer=self.tracer,
         )
         # The interoperable public edge (tonic parity): gRPC services over
         # the same seams, mounted on consensus_api_grpc_address.
@@ -409,6 +430,8 @@ class PrimaryNode:
             self.block_waiter,
             self.block_remover,
             dag=self.dag,
+            registry=self.registry,
+            tracer=self.tracer,
         )
         self.api_address: str = ""
         self.grpc_api_address: str = ""
@@ -493,11 +516,7 @@ class PrimaryNode:
         from .messages import BackpressureMsg
         from .pacing import backpressure_level
 
-        gauge = self.registry.gauge(
-            "node_backpressure_level",
-            "Downstream backlog level pushed to our workers (max of channel "
-            "occupancy, commit-latency-vs-target, and commit-stall signals)",
-        )
+        gauge = self._backpressure_gauge
         interval = self.parameters.backpressure_poll_interval
         target = env_float(
             "NARWHAL_COMMIT_LATENCY_TARGET", self.parameters.commit_latency_target
@@ -514,22 +533,59 @@ class PrimaryNode:
         ]
         if self.executor is not None:
             channels.append(self.executor.tx_executor)
+        channel_names = (
+            "new_certificates",
+            "consensus_output",
+            "execution_output",
+            "primary_messages",
+            "our_digests",
+            "executor_core",
+        )
         commit_counter = self.consensus_metrics.committed_certificates
         commit_timer = self.consensus_metrics.commit_timer
         last_committed = commit_counter.get()
         last_commit_t = clock.now()
+        # Dump-on-anomaly: the first poll that sees the commit pipeline
+        # silent for stall_after seconds snapshots every live flight
+        # recorder (re-armed when commits resume, so a long outage yields
+        # one dump per stall episode, not one per poll).
+        stall_after = env_float(
+            "NARWHAL_COMMIT_STALL_AFTER", max(5.0, 10.0 * target)
+        )
+        stall_armed = True
         while True:
             committed = commit_counter.get()
             if committed != last_committed:
                 last_committed, last_commit_t = committed, clock.now()
+                stall_armed = True
+            stale = (clock.now() - last_commit_t) if committed > 0 else None
             level = backpressure_level(
                 (ch.occupancy() for ch in channels),
                 commit_timer.ewma,
-                (clock.now() - last_commit_t) if committed > 0 else None,
+                stale,
                 target,
                 self.parameters.backpressure_high_watermark,
             )
             gauge.set(level)
+            # Flight-recorder breadcrumb: channel occupancy + admission
+            # level each poll, always on (instants ride the bounded ring
+            # regardless of NARWHAL_TRACE).
+            self.tracer.instant(
+                "backpressure",
+                level=round(level, 4),
+                committed=committed,
+                occupancy={
+                    n: ch.qsize() for n, ch in zip(channel_names, channels)
+                },
+            )
+            if stall_armed and stale is not None and stale > stall_after:
+                stall_armed = False
+                from . import tracing
+
+                tracing.on_anomaly(
+                    f"commit_stall node={self.name.hex()[:8]} "
+                    f"stale={stale:.1f}s committed={committed}"
+                )
             msg = BackpressureMsg.from_level(level)
             workers = self.worker_cache.our_workers(self.name).values()
             await asyncio.gather(
@@ -543,6 +599,10 @@ class PrimaryNode:
             await asyncio.sleep(interval)
 
     async def shutdown(self) -> None:
+        # Park this node's flight recorder in the module archive first:
+        # post-mortem dumps (test hooks, scenario teardown) must survive
+        # the tracer's owner being garbage collected.
+        self.tracer.archive()
         for t in self._tasks:
             t.cancel()
         await drain_cancelled(self._tasks, who="primary-node")
@@ -585,6 +645,7 @@ class WorkerNode:
     ):
         self.registry = registry or Registry()
         self.storage = storage
+        self.tracer = Tracer(node=f"worker-{name.hex()[:8]}-{worker_id}")
         self.worker = Worker(
             name,
             worker_id,
@@ -595,12 +656,14 @@ class WorkerNode:
             registry=self.registry,
             benchmark=benchmark,
             network_keypair=network_keypair,
+            tracer=self.tracer,
         )
 
     async def spawn(self) -> None:
         await self.worker.spawn()
 
     async def shutdown(self) -> None:
+        self.tracer.archive()
         await self.worker.shutdown()
         self.storage.close()
 

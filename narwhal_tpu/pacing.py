@@ -266,9 +266,16 @@ class IngestGate:
 
 class StageTimer:
     """One pipeline stage's latency: `start(key)` stamps, `stop(key)`
-    observes now-t0 into the stage's histogram child. The pending map is
-    bounded — keys that never stop (certificates that never commit, headers
-    GC'd mid-flight) are evicted oldest-first instead of leaking."""
+    closes the span and observes its duration into the stage's histogram
+    child. The pending map is bounded — keys that never stop (certificates
+    that never commit, headers GC'd mid-flight) are evicted oldest-first
+    instead of leaking.
+
+    The timer is ALSO the span layer's close site (tracing.Tracer): a
+    single `close()` both emits the causal span (when tracing is enabled
+    and the key samples in) and observes the histogram, so the stage
+    histograms are derived from span closes by construction — no double
+    bookkeeping, and the equivalence is pinned by test."""
 
     def __init__(
         self,
@@ -277,12 +284,14 @@ class StageTimer:
         max_pending: int = 8192,
         clock: Callable[[], float] = _now,
         ewma_alpha: float = 0.2,
+        tracer=None,  # tracing.Tracer: span sink for this stage's closes
     ):
         self._child = histogram.labels(stage)
         self._stage = stage
         self._max = max_pending
         self._clock = clock
         self._pending: dict = {}
+        self._tracer = tracer
         # Recent-latency EWMA alongside the histogram: the histogram's
         # sum/count is a lifetime mean, useless as a control signal — the
         # backpressure monitor reads this instead (None until first stop).
@@ -301,9 +310,24 @@ class StageTimer:
         t0 = self._pending.pop(key, None)
         if t0 is None:
             return None
-        dt = self._clock() - t0
-        self.observe(dt)
-        return dt
+        return self.close(key, t0)
+
+    def close(self, key, t0: float) -> float:
+        """Close the stage span opened at t0 for `key`: emit the trace span
+        and derive the histogram observation from the same close. Callers
+        that learn the key only at the end of the stage (batch seal: the
+        digest exists once the batch is sealed) call this directly."""
+        t1 = self._clock()
+        tracer = self._tracer
+        if (
+            tracer is not None
+            and tracer.enabled
+            and isinstance(key, bytes)
+            and tracer.sampled(key)
+        ):
+            tracer.span(self._stage, key, t0, t1)
+        self.observe(t1 - t0)
+        return t1 - t0
 
     def observe(self, seconds: float) -> None:
         """Directly record a latency measured elsewhere (same histogram)."""

@@ -13,14 +13,22 @@ framework's typed RPC on its own listener:
 - Configuration.NewEpoch    -> unimplemented (parity: configuration.rs:52-81)
 - Configuration.NewNetworkInfo -> Committee.update_primary_network_info
 - Configuration.GetPrimaryAddress
+- Telemetry.Scrape          -> Registry.render (Prometheus text exposition)
+- Telemetry.DumpFlightRecorder -> tracing.Tracer.dump (JSON)
+
+The telemetry pair rides this typed listener so it is fabric-reachable
+under simnet (grpc.aio binds real sockets and is skipped there).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 
 from ..config import Committee
 from ..messages import (
+    FlightDumpMsg,
+    FlightDumpResponse,
     GetCollectionsRequest,
     GetCollectionsResponse,
     GetPrimaryAddressRequest,
@@ -33,6 +41,8 @@ from ..messages import (
     RemoveCollectionsRequest,
     RoundsRequest,
     RoundsResponse,
+    TelemetryScrapeMsg,
+    TelemetryScrapeResponse,
 )
 from ..network import RpcServer
 from ..types import PublicKey
@@ -52,6 +62,8 @@ class ConsensusApi:
         dag=None,
         primary_address: str = "",
         max_concurrency: int = 100,
+        registry=None,  # metrics.Registry: Telemetry.Scrape source
+        tracer=None,  # tracing.Tracer: Telemetry.DumpFlightRecorder source
     ):
         self.name = name
         self._committee = committee
@@ -59,6 +71,8 @@ class ConsensusApi:
         self.block_remover = block_remover
         self.dag = dag
         self.primary_address = primary_address
+        self.registry = registry
+        self.tracer = tracer
         self.server = RpcServer(max_concurrency)
         self.address: str = ""
 
@@ -78,6 +92,8 @@ class ConsensusApi:
         self.server.route(NewEpochRequest, self._on_new_epoch)
         self.server.route(NewNetworkInfoRequest, self._on_new_network_info)
         self.server.route(GetPrimaryAddressRequest, self._on_get_primary_address)
+        self.server.route(TelemetryScrapeMsg, self._on_scrape)
+        self.server.route(FlightDumpMsg, self._on_flight_dump)
         logger.info("Consensus API listening on %s", self.address)
         return self.address
 
@@ -162,3 +178,20 @@ class ConsensusApi:
 
     async def _on_get_primary_address(self, msg: GetPrimaryAddressRequest, peer: str):
         return GetPrimaryAddressResponse(self.primary_address)
+
+    # -- Telemetry ---------------------------------------------------------
+
+    async def _on_scrape(self, msg: TelemetryScrapeMsg, peer: str):
+        if self.registry is None:
+            raise RuntimeError("Telemetry.Scrape: node mounted no registry")
+        return TelemetryScrapeResponse(self.registry.render())
+
+    async def _on_flight_dump(self, msg: FlightDumpMsg, peer: str):
+        if self.tracer is None:
+            raise RuntimeError(
+                "Telemetry.DumpFlightRecorder: node mounted no tracer"
+            )
+        dump = self.tracer.dump(msg.max_events or None)
+        return FlightDumpResponse(
+            json.dumps(dump, sort_keys=True, separators=(",", ":")).encode()
+        )

@@ -283,8 +283,19 @@ class Core:
             if self.metrics is not None:
                 self.metrics.certificates_created.inc()
                 # Stage tracing: the proposer started this clock when it
-                # proposed the header this certificate certifies.
+                # proposed the header this certificate certifies. The causal
+                # key hops header -> certificate here, so record the link
+                # edge the waterfall joins on.
                 self.metrics.certify_timer.stop(certificate.header.digest)
+                tracer = self.metrics.tracer
+                if (
+                    tracer is not None
+                    and tracer.enabled
+                    and tracer.sampled(certificate.header.digest)
+                ):
+                    tracer.link(
+                        "certify", certificate.header.digest, certificate.digest
+                    )
             # Compact certificates broadcast by reference (peers hold the
             # header already — they voted on it); full-format ones shed the
             # embedded header body the same way under header_wire="delta".

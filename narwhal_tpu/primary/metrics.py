@@ -7,7 +7,11 @@ from ..pacing import StageTimer
 
 
 class PrimaryMetrics:
-    def __init__(self, registry: Registry):
+    def __init__(self, registry: Registry, tracer=None):
+        # The node's tracing.Tracer rides with the metrics object: every
+        # actor already holds the metrics, so the span/link sites need no
+        # extra plumbing.
+        self.tracer = tracer
         # -- pacing / stage tracing ----------------------------------------
         self.stage_latency = registry.histogram(
             "primary_stage_latency_seconds",
@@ -19,8 +23,8 @@ class PrimaryMetrics:
         # Shared timers: the proposer starts them, the proposer (propose)
         # or the core (certify) stops them. Bounded maps — headers that
         # never certify and digests dropped on epoch reset age out.
-        self.propose_timer = StageTimer(self.stage_latency, "propose")
-        self.certify_timer = StageTimer(self.stage_latency, "certify")
+        self.propose_timer = StageTimer(self.stage_latency, "propose", tracer=tracer)
+        self.certify_timer = StageTimer(self.stage_latency, "certify", tracer=tracer)
         self.effective_header_delay = registry.gauge(
             "primary_effective_header_delay_seconds",
             "The adaptive header delay currently in force (floor when "

@@ -75,6 +75,7 @@ class Primary:
         registry: Registry | None = None,
         crypto_pool=None,  # AsyncVerifierPool: enables the pre-verify stage
         network_keypair=None,
+        tracer=None,  # tracing.Tracer: the node's span/flight recorder
     ):
         self.name = name
         self.committee = committee
@@ -82,7 +83,12 @@ class Primary:
         self.parameters = parameters
         self.storage = storage
         self.registry = registry or Registry()
-        self.metrics = PrimaryMetrics(self.registry)
+        if tracer is None:
+            from ..tracing import Tracer
+
+            tracer = Tracer(node=f"primary-{name.hex()[:8]}")
+        self.tracer = tracer
+        self.metrics = PrimaryMetrics(self.registry, tracer=tracer)
 
         # Transport identity (the anemo PeerId model, p2p.rs:26-158): with a
         # network keypair the primary mesh requires the mutual handshake;

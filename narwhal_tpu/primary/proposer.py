@@ -134,8 +134,14 @@ class Proposer:
         if self.metrics is not None:
             # Stage tracing: digest arrival -> included in a header, and the
             # certify clock this header's certificate will stop in the core.
+            # The causal key hops here — batch digests fold into the header
+            # digest — so record the link edges the waterfall joins on.
+            tracer = self.metrics.tracer
+            trace = tracer is not None and tracer.enabled
             for digest, _ in self.digests:
                 self.metrics.propose_timer.stop(digest)
+                if trace and tracer.sampled(digest):
+                    tracer.link("propose", digest, header.digest)
             self.metrics.certify_timer.start(header.digest)
         self.digests.clear()
         self.payload_size = 0

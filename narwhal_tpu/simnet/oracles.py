@@ -12,7 +12,9 @@ committed rounds at every fault-plan event (`round_marks`), so "rounds
 advance after heal" is `min over honest live nodes of (end - mark_at_heal)
 >= min_rounds`.
 
-Both raise AssertionError with enough context to debug the divergence.
+Both raise AssertionError with enough context to debug the divergence — and
+both snapshot every live/archived flight recorder first (tracing.on_anomaly),
+so the pytest failure hook can attach the rings that led up to the violation.
 """
 
 from __future__ import annotations
@@ -20,6 +22,16 @@ from __future__ import annotations
 
 class OracleViolation(AssertionError):
     pass
+
+
+def _violation(message: str) -> OracleViolation:
+    """Build the violation AFTER parking flight-recorder dumps in the
+    tracing archive: by the time an oracle runs, the scenario's nodes are
+    torn down, so the archived rings are the only record of the run."""
+    from .. import tracing
+
+    tracing.on_anomaly(f"oracle: {message[:120]}")
+    return OracleViolation(message)
 
 
 def _by_epoch(seq):
@@ -44,7 +56,7 @@ def assert_safety(commits, honest=None) -> None:
                 n = min(len(sa), len(sb))
                 for k in range(n):
                     if sa[k] != sb[k]:
-                        raise OracleViolation(
+                        raise _violation(
                             f"SAFETY: nodes {ai} and {bi} disagree at epoch "
                             f"{epoch} commit #{k}: {sa[k]} vs {sb[k]} "
                             f"(sequences of {len(sa)} vs {len(sb)})"
@@ -64,7 +76,7 @@ def assert_liveness(
         base = baseline_rounds[i] if baseline_rounds is not None else 0.0
         progress = end_rounds[i] - base
         if progress < min_rounds:
-            raise OracleViolation(
+            raise _violation(
                 f"LIVENESS: node {i} advanced {progress} rounds "
                 f"(from {base} to {end_rounds[i]}), needed >= {min_rounds}"
             )

@@ -77,6 +77,11 @@ class ScenarioResult:
     # compact-committee run really exercised the half-aggregated form.
     cert_forms: list = field(default_factory=list)
     log_entries: list = field(default_factory=list, repr=False)
+    # Per-node flight-recorder dumps (tracing.Tracer.dump) captured before
+    # teardown: span edges + occupancy instants on the VIRTUAL clock, so the
+    # same seed reproduces a bit-identical traced event log (the trace
+    # determinism test keys on this field).
+    flight_dumps: list = field(default_factory=list, repr=False)
 
     def honest(self) -> list[int]:
         return [i for i in range(self.nodes) if i not in self.byzantine]
@@ -341,6 +346,15 @@ async def _drive(
     #    deterministic contract) -------------------------------------------
     mark("end")
     rounds = cluster.committed_rounds()
+    # Flight recorders, captured while the nodes are alive: every timestamp
+    # inside rides the virtual clock, so the dumps are part of the same-seed
+    # determinism contract the event log carries.
+    flight_dumps = []
+    for i, a in enumerate(cluster.authorities):
+        if a.primary is not None:
+            flight_dumps.append(a.primary.tracer.dump())
+        for wid in sorted(a.workers):
+            flight_dumps.append(a.workers[wid].tracer.dump())
     cert_forms = []
     for a in cluster.authorities:
         forms = {"compact": 0, "full": 0}
@@ -406,6 +420,7 @@ async def _drive(
         crashed=tuple(sorted(crashed)),
         cert_forms=cert_forms,
         log_entries=list(fabric.log.entries) if keep_log else [],
+        flight_dumps=flight_dumps,
     )
 
 

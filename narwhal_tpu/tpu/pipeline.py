@@ -36,6 +36,7 @@ import collections
 import logging
 from typing import Iterable, Sequence
 
+from ..clock import now
 from ..types import Certificate, ConsensusOutput
 
 logger = logging.getLogger("narwhal.tpu.pipeline")
@@ -49,15 +50,29 @@ class FusedCertificatePipeline:
     TpuTusk); state: the ConsensusState the engine mutates. `depth` is
     the number of verify batches kept in flight (2 = double-buffered)."""
 
-    def __init__(self, verifier, engine, state, start_index: int = 0, depth: int = 2):
+    def __init__(
+        self, verifier, engine, state, start_index: int = 0, depth: int = 2,
+        tracer=None,
+    ):
         self.verifier = verifier
         self.engine = engine
         self.state = state
         self.consensus_index = start_index
         self.depth = max(1, depth)
+        self.tracer = tracer
         self._inflight: collections.deque = collections.deque()
         self.outputs: list[ConsensusOutput] = []
         self.rejected: list[Certificate] = []
+
+    def _span_key(self, certs: Sequence[Certificate]):
+        """Device sub-spans are per-batch, keyed by the batch's first
+        certificate digest (the batch has no digest of its own); the n=
+        attribute records how many certificates the span covers."""
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled or not certs:
+            return None
+        key = certs[0].digest
+        return key if tracer.sampled(key) else None
 
     def feed(self, certs: Sequence[Certificate], committee=None) -> None:
         """Pack + dispatch one verify batch; resolves the oldest in-flight
@@ -70,6 +85,8 @@ class FusedCertificatePipeline:
         while len(self._inflight) >= self.depth:
             self._resolve_one()
         committee = committee or self.engine.committee
+        span_key = self._span_key(certs)
+        t_pack = now()
         items: list = []
         groups: list = []
         # Input order preserved: ("item", cert, lo, hi) spans index into the
@@ -88,14 +105,28 @@ class FusedCertificatePipeline:
                 cert_items = cert.verify_items(committee)
                 spans.append(("item", cert, len(items), len(items) + len(cert_items)))
                 items.extend(cert_items)
+        t_dispatch = now()
         handle = self.verifier.submit(items)
         ghandle = self.verifier.submit_groups(groups) if groups else None
-        self._inflight.append((spans, handle, ghandle))
+        if span_key is not None:
+            n = len(certs)
+            self.tracer.span("device_pack", span_key, t_pack, t_dispatch, {"n": n})
+            self.tracer.span("device_dispatch", span_key, t_dispatch, now(), {"n": n})
+        self._inflight.append((spans, handle, ghandle, span_key))
 
     def _resolve_one(self) -> None:
-        spans, handle, ghandle = self._inflight.popleft()
+        spans, handle, ghandle, span_key = self._inflight.popleft()
+        t_collect = now()
         ok = self.verifier.collect(handle)
         gok = self.verifier.collect_groups(ghandle) if ghandle is not None else []
+        if span_key is not None:
+            # collect() blocks on the device->host verdict copies: the
+            # mask-readback sub-span of this batch's device-plane timeline.
+            self.tracer.span(
+                "device_mask_readback", span_key, t_collect, now(),
+                {"n": len(spans)},
+            )
+        t_epilogue = now()
         accepted: list[Certificate] = []
         for span in spans:
             if span[0] == "group":
@@ -116,6 +147,12 @@ class FusedCertificatePipeline:
             )
             self.consensus_index += len(outs)
             self.outputs.extend(outs)
+        if span_key is not None:
+            # Host-side verdict unpack + DAG/commit bookkeeping after the
+            # readback landed.
+            self.tracer.span(
+                "host_epilogue", span_key, t_epilogue, now(), {"n": len(spans)}
+            )
 
     def drain(self) -> list[ConsensusOutput]:
         """Resolve every in-flight batch and return the full committed

@@ -46,11 +46,11 @@ class BatchMaker:
         self._pending_count = 0
         self._pending_bytes = 0
         # Arrival of the first chunk since the last seal: the seal-stage
-        # latency sample (worker_stage_latency_seconds{stage="seal"}).
+        # latency sample (worker_stage_latency_seconds{stage="seal"}),
+        # closed through the span-unified seal timer — the batch digest is
+        # the waterfall's root causal key and exists only at seal time.
         self._pending_t0: float | None = None
-        self._seal_stage = (
-            metrics.stage_latency.labels("seal") if metrics is not None else None
-        )
+        self._seal_timer = metrics.seal_timer if metrics is not None else None
 
     def spawn(self) -> asyncio.Task:
         return asyncio.ensure_future(self.run())
@@ -126,7 +126,7 @@ class BatchMaker:
         if self.metrics is not None:
             self.metrics.created_batch_size.observe(size)
             self.metrics.batches_made.inc()
-        if self._seal_stage is not None and self._pending_t0 is not None:
-            self._seal_stage.observe(now() - self._pending_t0)
+        if self._seal_timer is not None and self._pending_t0 is not None:
+            self._seal_timer.close(batch.digest, self._pending_t0)
         self._pending_t0 = None
         await self.tx_message.send(batch)

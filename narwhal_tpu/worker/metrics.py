@@ -3,10 +3,12 @@
 from __future__ import annotations
 
 from ..metrics import Registry
+from ..pacing import StageTimer
 
 
 class WorkerMetrics:
-    def __init__(self, registry: Registry):
+    def __init__(self, registry: Registry, tracer=None):
+        self.tracer = tracer
         # -- pacing / admission control / stage tracing --------------------
         self.stage_latency = registry.histogram(
             "worker_stage_latency_seconds",
@@ -14,6 +16,10 @@ class WorkerMetrics:
             "pending transaction chunk -> batch sealed)",
             labels=("stage",),
         )
+        # Span-unified close site for the seal stage: the batch digest (the
+        # waterfall's root causal key) exists only once the batch seals, so
+        # the batch maker calls seal_timer.close(digest, t0) directly.
+        self.seal_timer = StageTimer(self.stage_latency, "seal", tracer=tracer)
         self.effective_batch_delay = registry.gauge(
             "worker_effective_batch_delay_seconds",
             "The adaptive seal delay currently in force (floor when queues "

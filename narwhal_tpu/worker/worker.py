@@ -72,6 +72,7 @@ class Worker:
         registry: Registry | None = None,
         benchmark: bool = False,
         network_keypair=None,
+        tracer=None,  # tracing.Tracer: the node's span/flight recorder
     ):
         self.name = name
         self.worker_id = worker_id
@@ -80,7 +81,12 @@ class Worker:
         self.parameters = parameters
         self.store = store
         self.registry = registry or Registry()
-        self.metrics = WorkerMetrics(self.registry)
+        if tracer is None:
+            from ..tracing import Tracer
+
+            tracer = Tracer(node=f"worker-{name.hex()[:8]}-{worker_id}")
+        self.tracer = tracer
+        self.metrics = WorkerMetrics(self.registry, tracer=tracer)
         self.benchmark = benchmark
 
         # Transport identity (worker.rs:137-146 registers worker network keys

@@ -34,6 +34,62 @@ def pytest_configure(config):
         jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 
+# Test files whose failures involve whole clusters (real or simulated):
+# those are the ones where a post-mortem needs the per-node flight
+# recorders, and the only ones worth the report bloat.
+_FLIGHT_DUMP_FILES = (
+    "test_lifecycle.py",
+    "test_reconfigure.py",
+    "test_simnet.py",
+    "test_node.py",
+    "test_telemetry.py",
+)
+_FLIGHT_DUMP_MAX_EVENTS = 400
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """On cluster/simnet test failure, attach every node's flight-recorder
+    dump (live tracers + the archive of already-shutdown nodes) to the
+    report, as self-contained JSON the terminal reporter prints under its
+    own section. The rings accumulate span edges, backpressure/occupancy
+    snapshots, and anomaly markers regardless of NARWHAL_TRACE, so even an
+    untraced run leaves a usable post-mortem."""
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    if not any(f in str(item.fspath) for f in _FLIGHT_DUMP_FILES):
+        return
+    try:
+        import json
+
+        from narwhal_tpu import tracing
+
+        dumps = tracing.all_dumps(max_events=_FLIGHT_DUMP_MAX_EVENTS)
+        if not dumps:
+            return
+        payload = json.dumps(dumps, sort_keys=True, indent=1, default=str)
+        # Bound the section so one failure can't flood the report.
+        if len(payload) > 200_000:
+            payload = payload[:200_000] + "\n... [truncated]"
+        report.sections.append(
+            (f"flight recorder ({len(dumps)} node dumps)", payload)
+        )
+    except Exception as exc:  # never let diagnostics break reporting
+        report.sections.append(("flight recorder", f"dump failed: {exc!r}"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight_archive():
+    """Scope flight-recorder post-mortems to the failing test: dumps parked
+    by a previous test's teardown must not masquerade as this test's."""
+    from narwhal_tpu import tracing
+
+    tracing.clear_archive()
+    yield
+
+
 @pytest.fixture
 def run():
     """Run a coroutine to completion on a fresh event loop.

@@ -124,6 +124,12 @@ def _golden_messages():
         M.Relay2Msg: M.Relay2Msg(1, 3, 0, 2, b"\x66" * 16),
         M.RelayAck2Msg: M.RelayAck2Msg(d1, 2),
         M.Vote2Msg: M.Vote2Msg.from_vote(vote),
+        M.TelemetryScrapeMsg: M.TelemetryScrapeMsg(),
+        M.TelemetryScrapeResponse: M.TelemetryScrapeResponse(
+            "# HELP x y\n# TYPE x counter\nx 1.0\n"
+        ),
+        M.FlightDumpMsg: M.FlightDumpMsg(256),
+        M.FlightDumpResponse: M.FlightDumpResponse(b'{"node":"n0"}'),
     }
 
 

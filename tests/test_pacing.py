@@ -237,7 +237,7 @@ def test_backpressure_level_folds_three_signals():
 
 def test_stage_timer_ewma_tracks_recent():
     reg = Registry()
-    hist = reg.histogram("e_stage_latency_seconds", "", labels=("stage",))
+    hist = reg.histogram("node_stage_latency_seconds", "", labels=("stage",))
     t = StageTimer(hist, "commit", ewma_alpha=0.5)
     assert t.ewma is None
     t.observe(1.0)
@@ -415,13 +415,13 @@ def test_batch_maker_deep_queue_keeps_ceiling(run):
 
 def test_stage_timer_records_and_bounds():
     reg = Registry()
-    hist = reg.histogram("t_stage_latency_seconds", "", labels=("stage",))
+    hist = reg.histogram("node_stage_latency_seconds", "", labels=("stage",))
     now = [100.0]
     t = StageTimer(hist, "commit", max_pending=4, clock=lambda: now[0])
     t.start("a")
     now[0] = 100.25
     assert t.stop("a") == pytest.approx(0.25)
-    assert reg.value("t_stage_latency_seconds", "commit") == 1
+    assert reg.value("node_stage_latency_seconds", "commit") == 1
     assert t.stop("a") is None  # idempotent
     # Re-delivery must not reset the clock.
     t.start("b")

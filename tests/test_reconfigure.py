@@ -169,6 +169,117 @@ def test_partial_committee_change(run):
     run(scenario(), timeout=120.0)
 
 
+def test_partial_committee_change_deterministic_simnet(monkeypatch):
+    """Regression for the test_partial_committee_change contention flake:
+    the SAME semantics (authority 3 replaced by a fresh identity whose node
+    never starts, epoch bumped, three survivors must keep certifying) on
+    the simnet virtual clock, where 1-core host contention cannot slow the
+    survivors — a failure here is a protocol bug, never a laggard. The
+    flight-recorder trace of the wall-clock flake is checked in at
+    tests/artifacts/partial_committee_change_flight.json; this test pins
+    the property that trace shows degrading (epoch adoption stalling the
+    epoch-1 quorum) in an environment where only logic can break it."""
+    import hashlib
+    import json
+    import random as _random
+
+    from narwhal_tpu import tracing
+    from narwhal_tpu.config import Parameters
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu.network import (
+        Credentials,
+        auth as _auth,
+        committee_resolver,
+        transport,
+    )
+    from narwhal_tpu.simnet import LinkSpec, SimFabric, SimLoop
+    from narwhal_tpu.simnet.cluster import SimCluster
+
+    monkeypatch.setenv("NARWHAL_TRACE", "1")
+    seed = 21
+    loop = SimLoop()
+    asyncio.set_event_loop(loop)
+    fabric = SimFabric(seed=seed, default_link=LinkSpec(latency=0.002))
+    transport.install(fabric)
+    _random.seed(seed)
+    entropy_state = [b"simnet" + seed.to_bytes(8, "big")]
+
+    def seeded_entropy(n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            entropy_state[0] = hashlib.sha256(entropy_state[0]).digest()
+            out += entropy_state[0]
+        return out[:n]
+
+    prev_entropy = _auth.set_entropy(seeded_entropy)
+
+    params = Parameters(
+        max_header_delay=0.1,
+        max_batch_delay=0.05,
+        header_delay_floor=0.05,
+        batch_delay_floor=0.02,
+    )
+
+    async def main():
+        cluster = SimCluster(size=4, fabric=fabric, workers=1, parameters=params)
+        await cluster.start()
+        clients = []
+        try:
+            await _wait_epoch_progress(cluster, 0, 2, timeout=60.0)
+            # Replace authority 3 with a brand-new identity (its node never
+            # starts) and advance the epoch — the real test's exact edit.
+            doc = json.loads(cluster.committee.to_json())
+            entry = doc["authorities"].pop(cluster.fixture.authorities[3].public.hex())
+            entry["network_key"] = KeyPair.generate().public.hex()
+            doc["authorities"][KeyPair.generate().public.hex()] = entry
+            doc["epoch"] = 1
+            msg = ReconfigureMsg("new_epoch", json.dumps(doc))
+            for i in range(3):
+                client = NetworkClient(
+                    credentials=Credentials(
+                        cluster.fixture.authorities[i].worker_keypairs[0],
+                        committee_resolver(
+                            lambda: cluster.committee, lambda: cluster.worker_cache
+                        ),
+                    )
+                )
+                clients.append(client)
+                assert await client.unreliable_send(
+                    cluster.authorities[i].primary.address, msg, timeout=5.0
+                )
+            await cluster.crash_node(3)
+            # Virtual seconds: generous and FREE — no host-load sensitivity.
+            await _wait_epoch_progress(cluster, 1, 4, timeout=120.0)
+            # The flight recorder saw the survivors' epoch-1 commit spans:
+            # the waterfall evidence the wall-clock flake's artifact lacks
+            # past the stall point.
+            dumps = [
+                cluster.authorities[i].primary.tracer.dump() for i in range(3)
+            ]
+            falls = tracing.waterfall(dumps)
+            assert any(
+                "commit" in v["stages"] and "certify" in v["stages"]
+                for v in falls.values()
+            )
+            assert any(epoch == 1 for epoch, _, _ in cluster.commits[0])
+        finally:
+            for client in clients:
+                client.close()
+            await cluster.shutdown()
+
+    try:
+        loop.run_until_complete(asyncio.wait_for(main(), 600.0))
+    finally:
+        _auth.set_entropy(prev_entropy)
+        transport.uninstall()
+        for t in asyncio.all_tasks(loop):
+            t.cancel()
+        loop.run_until_complete(asyncio.sleep(0))
+        loop.run_until_complete(loop.shutdown_asyncgens())
+        asyncio.set_event_loop(None)
+        loop.close()
+
+
 def test_restart_into_new_committee_via_node_restarter(run):
     """NodeRestarter-driven epoch change (node/tests/reconfigure.rs,
     restarter.rs): every primary is torn down and respawned against the

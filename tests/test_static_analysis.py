@@ -95,6 +95,7 @@ EXPECTED_RULES = {
     "no-wall-clock-in-actors",
     "no-untracked-jit",
     "no-per-item-cert-verify",
+    "metric-naming",
 }
 
 FIXTURE_FOR = {
@@ -133,6 +134,10 @@ FIXTURE_FOR = {
     "no-per-item-cert-verify": (
         "primary/cert_verify_trip.py",
         "primary/cert_verify_clean.py",
+    ),
+    "metric-naming": (
+        "metric_naming_trip.py",
+        "metric_naming_clean.py",
     ),
 }
 
@@ -180,6 +185,8 @@ def test_fixture_finding_counts():
         "no-untracked-jit": 3,
         # certificate.verify, cert.verify, raw host_verify_aggregate
         "no-per-item-cert-verify": 3,
+        # bad snake_case, unknown subsystem, unitless histogram
+        "metric-naming": 3,
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -12,6 +12,7 @@
 | no-unbounded-channel      | default-capacity edges defeating admission control|
 | no-wall-clock-in-actors   | wall time leaking past the simnet virtual clock   |
 | no-untracked-jit          | duplicate multi-minute kernel compiles (rc=124)   |
+| metric-naming             | scrape-surface drift: unparseable/unitless names  |
 
 Rules are pure `ast` visitors over one `Module` at a time; registration is
 import-time via the `@register` decorator so `RULES` is the single catalog
@@ -23,6 +24,7 @@ tests/lint_fixtures/) — the catalog test enforces the fixture pairing.
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import PurePath
 from typing import Iterable, Iterator
 
@@ -1125,3 +1127,77 @@ class NoPerItemCertVerify(Rule):
                 "verify_aggregate), or justify a documented no-pool "
                 "fallback with `# lint: allow(no-per-item-cert-verify)`",
             )
+
+
+# ---------------------------------------------------------------------------
+# metric-naming
+# ---------------------------------------------------------------------------
+
+
+@register
+class MetricNaming(Rule):
+    name = "metric-naming"
+    summary = (
+        "registry.counter/gauge/histogram names must follow "
+        "<subsystem>_<name>[_<unit>]: snake_case, a known subsystem prefix, "
+        "and a unit suffix on histograms — the checked-in metrics catalog "
+        "(tools/metrics_catalog.json) and every dashboard key on this "
+        "grammar, so a drive-by name invents a subsystem or loses its unit "
+        "silently"
+    )
+
+    _METHODS = frozenset({"counter", "gauge", "histogram"})
+    _SUBSYSTEMS = frozenset(
+        {"consensus", "executor", "node", "primary", "storage", "telemetry",
+         "wire", "worker"}
+    )
+    # Histogram units in use; 'size'/'certificate' are count-like units
+    # (created_batch_size, fetch_rpcs_per_certificate).
+    _UNITS = frozenset({"seconds", "bytes", "size", "certificate"})
+    _NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._METHODS
+                and node.args
+            ):
+                continue
+            first = node.args[0]
+            # Computed names (the f-string channel-depth gauges built by
+            # metered_channel) are covered by their own construction seam.
+            if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+                continue
+            name = first.value
+            if not self._NAME_RE.match(name):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"metric name {name!r} is not snake_case "
+                    "(lowercase segments joined by single underscores)",
+                )
+                continue
+            subsystem = name.split("_", 1)[0]
+            if subsystem not in self._SUBSYSTEMS:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"metric name {name!r} starts with unknown subsystem "
+                    f"{subsystem!r}; use one of "
+                    f"{'/'.join(sorted(self._SUBSYSTEMS))} (or extend the "
+                    "lint's subsystem set deliberately)",
+                )
+                continue
+            if (
+                node.func.attr == "histogram"
+                and name.rsplit("_", 1)[-1] not in self._UNITS
+            ):
+                yield self.finding(
+                    mod,
+                    node,
+                    f"histogram {name!r} must end in a unit suffix "
+                    f"({'/'.join(sorted(self._UNITS))}) so readers know "
+                    "what the buckets measure",
+                )
