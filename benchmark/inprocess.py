@@ -327,6 +327,12 @@ def main() -> None:
         existing.append(record)
         with open(args.out, "w") as f:
             json.dump(existing, f, indent=2)
+    from tools.perf import ledger as perf_ledger
+
+    perf_ledger.append(
+        "inprocess", record,
+        scrape=record.get("telemetry_scrape"), argv=sys.argv[1:],
+    )
 
 
 if __name__ == "__main__":

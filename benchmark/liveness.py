@@ -355,6 +355,12 @@ def main() -> None:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
             json.dump(record, f, indent=1)
+    from tools.perf import ledger as perf_ledger
+
+    perf_ledger.append(
+        "liveness", record,
+        scrape=record.get("telemetry_scrape"), argv=sys.argv[1:],
+    )
 
 
 if __name__ == "__main__":

@@ -35,6 +35,24 @@ from narwhal_tpu.crypto import KeyPair
 from .logs import LogParser
 
 
+def parse_telemetry_addr(log_text: str) -> str | None:
+    """Extract the primary's gRPC telemetry endpoint from its boot log.
+
+    The node prints ONE machine-readable `TELEMETRY_ADDR=<host:port>`
+    line at spawn (narwhal_tpu/__main__.py) — the contract that replaced
+    regexing the human "gRPC public API listening on ..." log line, which
+    broke whenever the log format moved. The LAST occurrence wins (a
+    restarted node rebinds); an empty value means the gRPC plane is not
+    mounted and there is nothing to scrape."""
+    addr = None
+    for line in log_text.splitlines():
+        line = line.strip()
+        if line.startswith("TELEMETRY_ADDR="):
+            value = line.split("=", 1)[1].strip()
+            addr = value or None
+    return addr
+
+
 @dataclass
 class BenchParameters:
     nodes: int = 4
@@ -191,10 +209,8 @@ class LocalBench:
         """Scrape each primary subprocess's gRPC Telemetry service (the
         raw-bytes mirror any process can hit) before teardown, keyed by
         node index. The bound address is ephemeral, so it is read from the
-        node's own boot log line. Best-effort: a bench record is still
-        valid without its scrape."""
-        import re
-
+        node's own machine-readable TELEMETRY_ADDR= boot line. Best-effort:
+        a bench record is still valid without its scrape."""
         from narwhal_tpu.metrics import parse_exposition
 
         try:
@@ -205,12 +221,10 @@ class LocalBench:
         for i in range(alive):
             try:
                 with open(f"{self.base}/primary-{i}.log") as fh:
-                    m = re.search(
-                        r"gRPC public API listening on (\S+)", fh.read()
-                    )
-                if m is None:
+                    addr = parse_telemetry_addr(fh.read())
+                if addr is None:
                     continue
-                with grpc.insecure_channel(m.group(1)) as channel:
+                with grpc.insecure_channel(addr) as channel:
                     text = channel.unary_unary(
                         "/narwhal.Telemetry/Scrape",
                         request_serializer=lambda b: b,

@@ -856,6 +856,11 @@ def main() -> None:
         with open(args.out, "w") as fh:
             json.dump(rows, fh, indent=2)
             fh.write("\n")
+    import sys
+
+    from tools.perf import ledger as perf_ledger
+
+    perf_ledger.append("microbench", rows, argv=sys.argv[1:])
     if args.profile:
         prof = cProfile.Profile()
         prof.enable()

@@ -122,6 +122,13 @@ async def _run_node(args) -> None:
                 await ch.recv()
 
         _exec_drain = asyncio.ensure_future(_drain_execution_output())
+
+        # Machine-readable boot line: the primary's gRPC telemetry
+        # endpoint, for harnesses that scrape-then-kill (benchmark/
+        # local.py). Parsing the human "gRPC public API listening on ..."
+        # log line tied those harnesses to the log format; this line is
+        # the contract. Empty when the gRPC plane is not mounted.
+        print(f"TELEMETRY_ADDR={node.grpc_api_address}", flush=True)
     else:
         worker_seed = keys.get("worker_network_seeds", {}).get(str(args.id))
         if worker_seed is None and not args.insecure:

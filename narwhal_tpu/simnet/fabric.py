@@ -183,6 +183,7 @@ class _SimConnection:
                 r.set_exception(ConnectionResetError(reason))
         self.fabric._conns.discard(self)
         self.fabric.log.append("reset", self.id, reason)
+        self.fabric.counters["resets"] += 1
 
     def close(self, direction: int) -> None:
         """Graceful half-close from one side: the peer reads EOF.
@@ -218,10 +219,29 @@ class _SimConnection:
 class SimFabric:
     """The in-memory network: listeners, connections, link conditions."""
 
+    # Snapshot of the most recent fabric's counters: scenarios tear the
+    # instance down with the loop, so post-run tooling (the fabric
+    # profiler) reads the class-level alias instead.
+    last_counters: dict = {}
+
     def __init__(self, seed: int = 0, default_link: LinkSpec | None = None):
         self.rng = random.Random(seed)
         self.default_link = default_link or LinkSpec()
         self.log = EventLog()
+        # Hot-path tallies (plain dict, no locking: the loop is single
+        # threaded). Purely observational — nothing reads them to make
+        # decisions, so determinism is untouched.
+        self.counters = {
+            "dials": 0,
+            "connects": 0,
+            "transmits": 0,
+            "bytes_sent": 0,
+            "drops": 0,
+            "delivers": 0,
+            "bytes_delivered": 0,
+            "resets": 0,
+        }
+        SimFabric.last_counters = self.counters
         self._listeners: dict[str, _Listener] = {}
         self._conns: set[_SimConnection] = set()
         self._conn_ids = itertools.count(1)
@@ -325,6 +345,7 @@ class SimFabric:
         # exist, like a SYN exchange. The dial is logged at DRAW time so the
         # seeded rng stream is fully reconstructible from the event log.
         self.log.append("dial", src or "client", key)
+        self.counters["dials"] += 1
         delay = link.latency + (
             self.rng.uniform(0.0, link.jitter) if link.jitter else 0.0
         )
@@ -333,6 +354,7 @@ class SimFabric:
         conn = _SimConnection(self, src, dst or key, key, limit)
         self._conns.add(conn)
         self.log.append("connect", conn.id, src or "client", key)
+        self.counters["connects"] += 1
         server_writer = _SimWriter(conn, 1)
         client_writer = _SimWriter(conn, 0)
         # The handler task runs in the LISTENER's captured context so the
@@ -356,6 +378,7 @@ class SimFabric:
             # A lost segment on a framed AEAD stream is unrecoverable:
             # model it as the connection dying mid-flight.
             self.log.append("drop", conn.id, src, dst, len(data))
+            self.counters["drops"] += 1
             deliver_t = max(
                 now + link.latency, conn._next_deliver[direction]
             )
@@ -375,6 +398,8 @@ class SimFabric:
             "xmit", conn.id, src, dst, len(data),
             round(now, 9), round(deliver_t, 9),
         )
+        self.counters["transmits"] += 1
+        self.counters["bytes_sent"] += len(data)
         loop.call_at(deliver_t, self._deliver, conn, direction, data)
 
     @staticmethod
@@ -385,4 +410,6 @@ class SimFabric:
         # at_eof() is False while buffered bytes remain, so check the flag
         # itself: once EOF is fed, nothing more may enter the stream.
         if reader.exception() is None and not getattr(reader, "_eof", False):
+            conn.fabric.counters["delivers"] += 1
+            conn.fabric.counters["bytes_delivered"] += len(data)
             reader.feed_data(data)

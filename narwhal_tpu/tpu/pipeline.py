@@ -93,7 +93,13 @@ class FusedCertificatePipeline:
         # item verdicts, ("group", cert, g) into the group verdicts; g/lo of
         # None marks a signature-free certificate (genesis): valid.
         spans: list[tuple] = []
+        # Staging split (traced batches only — the untraced path pays no
+        # extra clock reads): items_s is the full-format per-vote item
+        # staging, groups_s the compact-format aggregate decompress. The
+        # epilogue attributor (tools/perf/epilogue.py) keys on these.
+        items_s = groups_s = 0.0
         for cert in certs:
+            t_cert = now() if span_key is not None else 0.0
             if cert.is_compact:
                 group = cert.aggregate_group(committee)
                 if group is None:
@@ -101,16 +107,31 @@ class FusedCertificatePipeline:
                 else:
                     spans.append(("group", cert, len(groups)))
                     groups.append(group)
+                if span_key is not None:
+                    groups_s += now() - t_cert
             else:
                 cert_items = cert.verify_items(committee)
                 spans.append(("item", cert, len(items), len(items) + len(cert_items)))
                 items.extend(cert_items)
+                if span_key is not None:
+                    items_s += now() - t_cert
         t_dispatch = now()
         handle = self.verifier.submit(items)
         ghandle = self.verifier.submit_groups(groups) if groups else None
         if span_key is not None:
             n = len(certs)
             self.tracer.span("device_pack", span_key, t_pack, t_dispatch, {"n": n})
+            # Sub-spans laid out back to back inside device_pack: widths are
+            # the measured per-branch staging time, which is what the
+            # attributor consumes.
+            self.tracer.span(
+                "pack_items", span_key, t_pack, t_pack + items_s,
+                {"n_items": len(items)},
+            )
+            self.tracer.span(
+                "pack_groups", span_key, t_pack + items_s,
+                t_pack + items_s + groups_s, {"n_groups": len(groups)},
+            )
             self.tracer.span("device_dispatch", span_key, t_dispatch, now(), {"n": n})
         self._inflight.append((spans, handle, ghandle, span_key))
 
@@ -141,6 +162,7 @@ class FusedCertificatePipeline:
                 accepted.append(cert)
             else:
                 self.rejected.append(cert)
+        t_unpack = now()
         if accepted:
             outs = self.engine.process_batch(
                 self.state, self.consensus_index, accepted
@@ -148,10 +170,22 @@ class FusedCertificatePipeline:
             self.consensus_index += len(outs)
             self.outputs.extend(outs)
         if span_key is not None:
-            # Host-side verdict unpack + DAG/commit bookkeeping after the
-            # readback landed.
+            # Host-side epilogue, split so its books balance: unpack
+            # (verdict routing) + commit (process_batch: DAG insert, commit
+            # walk, output bookkeeping) partition [t_epilogue, t_end]
+            # exactly — a stage added outside the two sub-spans shows up as
+            # unattributed drift in tools/perf/epilogue.py.
+            t_end = now()
             self.tracer.span(
-                "host_epilogue", span_key, t_epilogue, now(), {"n": len(spans)}
+                "epilogue_unpack", span_key, t_epilogue, t_unpack,
+                {"n": len(spans)},
+            )
+            self.tracer.span(
+                "epilogue_commit", span_key, t_unpack, t_end,
+                {"n_accepted": len(accepted)},
+            )
+            self.tracer.span(
+                "host_epilogue", span_key, t_epilogue, t_end, {"n": len(spans)}
             )
 
     def drain(self) -> list[ConsensusOutput]:

@@ -216,22 +216,32 @@ def waterfall(dumps: list[dict]) -> dict[str, dict]:
     parent_of: dict[str, list[str]] = {}  # child key -> parent keys
     for d in dumps:
         for ev in d.get("events", ()):
-            if ev[0] == "span":
+            # Dumps arrive over RPC from possibly-older nodes: skip any
+            # event too short for its kind instead of raising mid-stitch.
+            if ev[0] == "span" and len(ev) >= 5:
                 _, stage, key, t0, t1 = ev[:5]
                 best = spans.setdefault(key, {})
                 if stage not in best or t0 < best[stage][0]:
                     best[stage] = (t0, t1)
-            elif ev[0] == "link":
+            elif ev[0] == "link" and len(ev) >= 4:
                 _, _stage, parent, child = ev[:4]
-                parent_of.setdefault(child, []).append(parent)
+                if parent != child:  # a self-link stitches nothing
+                    parent_of.setdefault(child, []).append(parent)
 
     def ancestors(key: str, seen: set[str]) -> list[str]:
-        out = []
-        for p in parent_of.get(key, ()):
-            if p not in seen:
-                seen.add(p)
-                out.append(p)
-                out.extend(ancestors(p, seen))
+        # Iterative DFS with a seen-set: a cyclic link chain (two nodes
+        # disagreeing about direction) or an arbitrarily deep one (ring
+        # overflow splitting chains) degrades to a partial lineage instead
+        # of looping or blowing the stack.
+        out: list[str] = []
+        stack = list(parent_of.get(key, ()))
+        while stack:
+            p = stack.pop(0)
+            if p in seen:
+                continue
+            seen.add(p)
+            out.append(p)
+            stack[:0] = parent_of.get(p, ())
         return out
 
     # Roots = keys that are nobody's parent (certificate digests) OR keys

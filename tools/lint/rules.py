@@ -1147,9 +1147,12 @@ class MetricNaming(Rule):
     )
 
     _METHODS = frozenset({"counter", "gauge", "histogram"})
+    # "perf" is the observatory's namespace (tools/perf, benchmark.ab):
+    # perf_* metrics describe the MEASUREMENT plane (calibration capacity,
+    # leg timings), never protocol behaviour.
     _SUBSYSTEMS = frozenset(
-        {"consensus", "executor", "node", "primary", "storage", "telemetry",
-         "wire", "worker"}
+        {"consensus", "executor", "node", "perf", "primary", "storage",
+         "telemetry", "wire", "worker"}
     )
     # Histogram units in use; 'size'/'certificate' are count-like units
     # (created_batch_size, fetch_rpcs_per_certificate).

@@ -22,6 +22,47 @@ import os
 
 CATALOG_PATH = os.path.join(os.path.dirname(__file__), "metrics_catalog.json")
 
+# Span stages are catalog rows too: the flight-recorder stage vocabulary
+# is a scrape-surface contract exactly like metric names — waterfall
+# stitching, the stage-percentile tables, and the perf attributors
+# (tools/perf/epilogue.py) all key on these strings, so a renamed or
+# drive-by stage must show up in review as catalog drift. Rows are
+# `span:<stage>` with type "span_stage"; `roles` names the recording
+# component.
+SPAN_STAGES: tuple[tuple[str, str, str], ...] = (
+    ("seal", "worker", "batch sealed by a worker's BatchMaker"),
+    ("propose", "primary", "header proposed for the batch digests"),
+    ("certify", "primary", "votes aggregated into a certificate"),
+    ("commit", "consensus", "certificate committed by the commit rule"),
+    ("execute", "executor", "committed payload applied to execution state"),
+    ("device_pack", "device", "host staging of one verify batch "
+     "(verify_items / aggregate_group)"),
+    ("pack_items", "device", "device_pack sub-span: full-format per-vote "
+     "signature item staging"),
+    ("pack_groups", "device", "device_pack sub-span: compact-format "
+     "aggregate-group decompress staging"),
+    ("device_dispatch", "device", "async submit of the verify kernels"),
+    ("device_mask_readback", "device", "blocking device->host verdict copies"),
+    ("host_epilogue", "device", "post-readback host work for one batch"),
+    ("epilogue_unpack", "device", "host_epilogue sub-span: verdict unpack "
+     "+ accept/reject routing"),
+    ("epilogue_commit", "device", "host_epilogue sub-span: process_batch "
+     "DAG insert + commit walk + output bookkeeping"),
+)
+
+
+def span_stage_rows() -> list[dict]:
+    return [
+        {
+            "name": f"span:{stage}",
+            "type": "span_stage",
+            "labels": [],
+            "help": help_,
+            "roles": [role],
+        }
+        for stage, role, help_ in SPAN_STAGES
+    ]
+
 
 def extract_catalog() -> list[dict]:
     """Build both role registries and return sorted catalog rows."""
@@ -72,7 +113,9 @@ def extract_catalog() -> list[dict]:
                 row["roles"].append(role)
     primary.storage.close()
     worker.storage.close()
-    return sorted(rows.values(), key=lambda r: r["name"])
+    return sorted(
+        list(rows.values()) + span_stage_rows(), key=lambda r: r["name"]
+    )
 
 
 def load_catalog() -> list[dict]:

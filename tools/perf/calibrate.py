@@ -1,0 +1,110 @@
+"""Host-capacity calibration: the pinned probe behind every perf record.
+
+The bench host is a 1-core container whose effective capacity swings
+10-20x day to day (and hour to hour, when a sibling build lands on the
+same machine). A throughput number without the capacity it was measured
+under is therefore uninterpretable — so every ledger record and every
+A/B leg carries a `calibration_probe()` snapshot: a fixed CPU workload
+(a sha256 hash chain, pinned at module level so the work never drifts
+across revisions) timed for a short wall-clock window, plus the load
+average and a scan for concurrently-running pytest/bench processes (the
+usual source of "mystery" 2x swings mid-suite).
+
+The probe is intentionally cheap (~100 ms at default budget): it brackets
+every bench leg without perturbing it, and `drift(a, b)` quantifies how
+much the host moved between two probes — benchmark.ab refuses to issue a
+verdict when that drift exceeds its gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+# The pinned workload: one "op" is _CHAIN_STRIDE chained sha256 digests.
+# Chaining defeats any constant-folding and keeps the working set in L1,
+# so ops/s tracks available CPU cycles and nothing else.
+_CHAIN_STRIDE = 256
+_SEED = b"\x5anarwhal-perf-calibration\x5a" * 2
+
+
+def calibration_probe(budget_s: float = 0.1) -> dict:
+    """Time the pinned hash chain for ~budget_s and report capacity.
+
+    Returns a JSON-ready snapshot: `ops_per_s` (the capacity figure —
+    higher is a faster host), the measured window, loadavg, cpu count,
+    and the probe's unix timestamp.
+    """
+    h = hashlib.sha256
+    digest = _SEED
+    ops = 0
+    t0 = time.perf_counter()
+    deadline = t0 + budget_s
+    while time.perf_counter() < deadline:
+        for _ in range(_CHAIN_STRIDE):
+            digest = h(digest).digest()
+        ops += 1
+    elapsed = time.perf_counter() - t0
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:  # pragma: no cover - getloadavg absent on some hosts
+        load1 = load5 = load15 = -1.0
+    return {
+        "unix_time": time.time(),
+        "probe_s": elapsed,
+        "chain_ops": ops,
+        "ops_per_s": ops / elapsed if elapsed > 0 else 0.0,
+        "loadavg_1m": load1,
+        "loadavg_5m": load5,
+        "loadavg_15m": load15,
+        "cpu_count": os.cpu_count() or 1,
+    }
+
+
+def drift(a: dict, b: dict) -> float:
+    """Relative capacity swing between two probes: 0.0 = identical host,
+    1.0 = one probe saw double (or half) the other's ops/s."""
+    x, y = a.get("ops_per_s", 0.0), b.get("ops_per_s", 0.0)
+    if x <= 0 or y <= 0:
+        return float("inf")
+    hi, lo = max(x, y), min(x, y)
+    return hi / lo - 1.0
+
+
+def concurrent_processes(patterns: tuple[str, ...] = ("pytest", "benchmark")) -> list[dict]:
+    """Scan /proc for OTHER live processes whose cmdline mentions any of
+    `patterns` — the self-diagnosis hook for contention flakes (a second
+    pytest run on this 1-core host reliably trips liveness timeouts).
+
+    Best-effort: on hosts without /proc (or with restricted permissions)
+    it returns what it could see, never raises.
+    """
+    me = os.getpid()
+    found: list[dict] = []
+    try:
+        pids = [int(p) for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:  # pragma: no cover - no /proc
+        return found
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmdline = fh.read().replace(b"\x00", b" ").decode(errors="replace").strip()
+        except OSError:
+            continue
+        if any(pat in cmdline for pat in patterns):
+            found.append({"pid": pid, "cmdline": cmdline[:300]})
+    return found
+
+
+def host_context(probe_budget_s: float = 0.05) -> dict:
+    """The full host snapshot conftest attaches to failing cluster tests:
+    a (short-budget) calibration probe plus the concurrent-process scan."""
+    ctx = {"calibration": calibration_probe(budget_s=probe_budget_s)}
+    ctx["concurrent"] = concurrent_processes()
+    ctx["concurrent_pytest"] = any(
+        "pytest" in p["cmdline"] for p in ctx["concurrent"]
+    )
+    return ctx

@@ -1,0 +1,134 @@
+"""Device-plane epilogue attributor: where host_epilogue actually goes.
+
+ROADMAP item 5 caps multi-chip scaling on the HOST epilogue (~12.5x over
+the device verify), and dieting it needs a denominator: a per-batch
+breakdown of the epilogue into its constituents. tpu/pipeline.py records
+one span tree per sampled batch, keyed by the batch's first certificate
+digest:
+
+  device_pack            host pack: verify_items/aggregate_group staging
+    pack_items             - full-format per-vote signature item staging
+    pack_groups            - compact-format aggregate-group decompress
+  device_dispatch        async submit of the verify kernels
+  device_mask_readback   blocking device->host verdict copies
+  host_epilogue          everything after the readback lands
+    epilogue_unpack        - verdict unpack + accept/reject routing
+    epilogue_commit        - engine.process_batch: DAG insert + commit walk
+
+`attribute(dumps)` folds the flight-recorder dumps into per-batch rows
+and checks the books: the epilogue sub-spans must sum to within 10% of
+the measured host_epilogue span (the acceptance gate), with the
+remainder reported as `epilogue_unattributed_s` so a future stage added
+to the pipeline without a sub-span shows up as drift here instead of
+vanishing.
+
+benchmark/multichip.py runs this over its dryrun leg; it also works on
+any flight dump from a traced device-backed run.
+"""
+
+from __future__ import annotations
+
+# The epilogue constituents: sub-spans recorded INSIDE host_epilogue.
+EPILOGUE_PARTS = ("epilogue_unpack", "epilogue_commit")
+# The pack constituents: sub-spans recorded inside device_pack.
+PACK_PARTS = ("pack_items", "pack_groups")
+STAGES = (
+    "device_pack",
+    "device_dispatch",
+    "device_mask_readback",
+    "host_epilogue",
+) + EPILOGUE_PARTS + PACK_PARTS
+
+
+def attribute(dumps: list[dict]) -> dict:
+    """Fold flight dumps into the per-batch epilogue breakdown.
+
+    Returns {"batches": [row...], "totals": {...}} where each row carries
+    the batch key, n (certificates in the batch), every stage width, the
+    epilogue sub-span sum, and its relative error vs the measured
+    host_epilogue span.
+    """
+    # key -> stage -> [width_s, ...] (a key can only host one batch, but
+    # stay defensive: sum repeated spans).
+    per_key: dict[str, dict[str, float]] = {}
+    n_by_key: dict[str, int] = {}
+    for dump in dumps:
+        for event in dump.get("events", ()):
+            if not event or event[0] != "span":
+                continue
+            _, stage, key, t0, t1 = event[:5]
+            if stage not in STAGES:
+                continue
+            attrs = event[5] if len(event) > 5 and isinstance(event[5], dict) else {}
+            row = per_key.setdefault(key, {})
+            row[stage] = row.get(stage, 0.0) + (t1 - t0)
+            if "n" in attrs:
+                n_by_key[key] = attrs["n"]
+
+    batches = []
+    for key, stages in sorted(per_key.items()):
+        epilogue = stages.get("host_epilogue", 0.0)
+        parts = {p: stages.get(p, 0.0) for p in EPILOGUE_PARTS}
+        part_sum = sum(parts.values())
+        row = {
+            "batch_key": key,
+            "n": n_by_key.get(key, 0),
+            **{s: round(stages.get(s, 0.0), 6) for s in STAGES if s in stages},
+            "epilogue_parts_s": round(part_sum, 6),
+            "epilogue_unattributed_s": round(epilogue - part_sum, 6),
+            "epilogue_rel_err": round(abs(part_sum - epilogue) / epilogue, 4)
+            if epilogue > 0
+            else 0.0,
+        }
+        batches.append(row)
+
+    def total(stage: str) -> float:
+        return sum(per_key[k].get(stage, 0.0) for k in per_key)
+
+    epilogue_total = total("host_epilogue")
+    parts_total = sum(total(p) for p in EPILOGUE_PARTS)
+    totals = {
+        "batches": len(batches),
+        **{s: round(total(s), 6) for s in STAGES},
+        "epilogue_parts_s": round(parts_total, 6),
+        "epilogue_rel_err": round(abs(parts_total - epilogue_total) / epilogue_total, 4)
+        if epilogue_total > 0
+        else 0.0,
+        "epilogue_share_of_batch": round(
+            epilogue_total
+            / max(
+                1e-12,
+                total("device_pack")
+                + total("device_dispatch")
+                + total("device_mask_readback")
+                + epilogue_total,
+            ),
+            4,
+        ),
+    }
+    return {"batches": batches, "totals": totals}
+
+
+def render_table(report: dict) -> str:
+    totals = report["totals"]
+    lines = [
+        f"device epilogue attribution — {totals['batches']} batch(es), "
+        f"epilogue {totals.get('host_epilogue', 0.0):.4f}s "
+        f"({totals['epilogue_share_of_batch']:.0%} of the device-plane "
+        f"timeline), sub-span books balance to "
+        f"{totals['epilogue_rel_err']:.1%}",
+        f"{'batch':<18} {'n':>4} {'pack':>9} {'dispatch':>9} "
+        f"{'readback':>9} {'epilogue':>9} {'unpack':>9} {'commit':>9} {'err':>6}",
+    ]
+    for row in report["batches"]:
+        lines.append(
+            f"{row['batch_key'][:16]:<18} {row['n']:>4} "
+            f"{row.get('device_pack', 0.0):>9.4f} "
+            f"{row.get('device_dispatch', 0.0):>9.4f} "
+            f"{row.get('device_mask_readback', 0.0):>9.4f} "
+            f"{row.get('host_epilogue', 0.0):>9.4f} "
+            f"{row.get('epilogue_unpack', 0.0):>9.4f} "
+            f"{row.get('epilogue_commit', 0.0):>9.4f} "
+            f"{row['epilogue_rel_err']:>6.1%}"
+        )
+    return "\n".join(lines)
