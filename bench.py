@@ -277,5 +277,15 @@ if __name__ == "__main__":
         from benchmark.multichip import main as multichip_main
 
         multichip_main([a for a in sys.argv[1:] if a != "--multichip"])
+    elif "--fuzz" in sys.argv:
+        # The FaultPlan fuzzer: seeded random fault schedules under the
+        # simnet safety/liveness oracles, failures shrunk to minimal
+        # reproducers, one perf-ledger record per campaign. See
+        # narwhal_tpu/simnet/fuzz.py.
+        from narwhal_tpu.simnet.fuzz import main as fuzz_main
+
+        raise SystemExit(
+            fuzz_main([a for a in sys.argv[1:] if a != "--fuzz"])
+        )
     else:
         main()

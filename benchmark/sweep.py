@@ -73,6 +73,51 @@ def run_once(rate: int, args) -> dict:
     return record
 
 
+def run_fault_rows(args) -> list[dict]:
+    """The faults>0 axis, exercised: each row replays one seeded FaultPlan
+    (narwhal_tpu.simnet.fuzz.generate_plan) on the simnet fabric — virtual
+    clock, in-memory network — under the safety/liveness oracles. The seed
+    IS the experiment's identity: the same seed replays the same schedule
+    bit-identically, so a row here is reproducible where a wall-clock crash
+    bench is not."""
+    from narwhal_tpu.simnet import fuzz
+
+    rows: list[dict] = []
+    for seed in args.fault_seeds:
+        plan = fuzz.generate_plan(
+            seed, nodes=args.nodes, duration=args.fault_duration
+        )
+        ok, violation, result = fuzz.check_plan(
+            plan,
+            nodes=args.nodes,
+            duration=args.fault_duration,
+            load_rate=args.fault_load_rate,
+            workers=args.workers,
+        )
+        rows.append(
+            {
+                "fault_plan_seed": seed,
+                "plan": fuzz.describe_plan(plan),
+                "faults": len(plan.events),
+                "oracles_ok": ok,
+                "violation": violation,
+                "nodes": args.nodes,
+                "duration_virtual_s": args.fault_duration,
+                "load_rate": args.fault_load_rate,
+                "rounds": list(result.rounds) if result else None,
+                "commits": [len(c) for c in result.commits] if result else None,
+                "event_log_digest": result.event_log_digest if result else None,
+            }
+        )
+        events = [type(e).__name__ for e in plan.events]
+        peak = max(result.rounds) if result and result.rounds else "-"
+        print(
+            f"  fault seed {seed}: {'ok' if ok else 'VIOLATION'}  "
+            f"events {events}  peak round {peak}"
+        )
+    return rows
+
+
 def sweep(args) -> list[dict]:
     results: list[dict] = []
     if args.auto:
@@ -108,6 +153,8 @@ def render_table(results: list[dict]) -> str:
         "| input rate | consensus TPS | consensus lat | e2e lat |",
         "|---|---|---|---|",
     ]
+    # FaultPlan rows have no rate axis; they are printed as they run.
+    results = [r for r in results if "fault_plan_seed" not in r]
     for r in results:
         lines.append(
             f"| {r['input_rate']:,} | {r['consensus_tps']:,.0f} "
@@ -146,12 +193,29 @@ def main() -> None:
     ap.add_argument("--max-header-delay", type=float, default=0.1)
     ap.add_argument("--max-batch-delay", type=float, default=0.1)
     ap.add_argument("--rates", type=int, nargs="*", default=[5_000, 15_000, 30_000])
+    ap.add_argument(
+        "--fault-seeds", type=int, nargs="*", default=[],
+        help="additionally run one simnet row per seed, each under the "
+        "seeded FaultPlan that narwhal_tpu.simnet.fuzz.generate_plan "
+        "derives from it (safety/liveness oracles applied)",
+    )
+    ap.add_argument(
+        "--fault-load-rate", type=int, default=100,
+        help="client tx/s injected during each FaultPlan row (virtual time)",
+    )
+    ap.add_argument(
+        "--fault-duration", type=float, default=2.5,
+        help="virtual seconds per FaultPlan row",
+    )
     ap.add_argument("--auto", action="store_true", help="geometric ramp to the knee")
     ap.add_argument("--start-rate", type=int, default=2_000)
     ap.add_argument("--out", default=".bench/sweep.json")
     args = ap.parse_args()
 
-    results = sweep(args)
+    results = sweep(args) if (args.rates or args.auto) else []
+    if args.fault_seeds:
+        print("fault-plan rows (simnet, virtual clock):")
+        results.extend(run_fault_rows(args))
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(results, f, indent=2)

@@ -13,7 +13,7 @@ import asyncio
 import logging
 from dataclasses import replace
 
-from . import clock
+from . import clock, tracing
 from .config import (
     Authority,
     Committee,
@@ -96,6 +96,11 @@ class Cluster:
         max_batch_delay: float = 0.05,
         auth: bool = True,
     ):
+        # Each cluster is a fresh tracer incarnation: successive in-process
+        # clusters reuse node labels and (seeded fixtures) certificate
+        # digests, so without the bump `tracing.live_dumps()` would merge a
+        # prior cluster's spans into this one's waterfalls.
+        tracing.new_generation()
         self.fixture = CommitteeFixture(size=size, workers=workers)
         # The delay kwargs override the fixture defaults (fast rounds for
         # tests) but an explicitly passed Parameters wins outright — latency

@@ -239,6 +239,7 @@ class Committee:
         self._keys: list[PublicKey] = list(self.authorities)
         self._index: dict[PublicKey, int] = {pk: i for i, pk in enumerate(self._keys)}
         self._total_stake: Stake = sum(a.stake for a in self.authorities.values())
+        self._transcript_digest: bytes | None = None
 
     # -- size / stake -----------------------------------------------------
     def size(self) -> int:
@@ -263,6 +264,22 @@ class Committee:
     # -- identity ---------------------------------------------------------
     def authority_keys(self) -> list[PublicKey]:
         return self._keys
+
+    def transcript_digest(self) -> bytes:
+        """Content identity of this validator set (memoized): epoch plus
+        the canonical (public key, stake) sequence. Keys the process-wide
+        aggregate-verdict front cache, where verdicts reached under
+        different committees with overlapping signer indices must never
+        collide. Committees are immutable after construction (reconfigure
+        builds a new one), so memoizing is safe."""
+        d = self._transcript_digest
+        if d is None:
+            parts = [int(self.epoch).to_bytes(8, "little")]
+            for pk, a in self.authorities.items():
+                parts.append(pk)
+                parts.append(int(a.stake).to_bytes(8, "little"))
+            d = self._transcript_digest = digest256(b"".join(parts))
+        return d
 
     def index_of(self, name: PublicKey) -> int:
         return self._index[name]

@@ -73,9 +73,11 @@ class _RefPrivateKey:
     """RFC-8032 ed25519 signing over the in-tree pure-integer group math
     (`tpu/ed25519_ref.py`) — the fallback identity when the OpenSSL bindings
     are absent. Wire-compatible with ed25519-dalek/OpenSSL: same seed
-    expansion, same clamping, same (R, S) layout. A 4-bit fixed-base window
-    table makes the two per-signature base multiplications table walks
-    instead of full double-and-add ladders."""
+    expansion, same clamping, same (R, S) layout. A process-wide 8-bit
+    fixed-base window table makes the two per-signature base
+    multiplications 32-add table walks instead of full double-and-add
+    ladders — the table is built once and shared by every hosted node
+    (signing was the #1 line of the co-hosted simnet profile)."""
 
     __slots__ = ("_seed", "_scalar", "_prefix", "public")
 
@@ -95,26 +97,30 @@ class _RefPrivateKey:
 
     @classmethod
     def _g_mul(cls, s: int):
-        """[s]B via 4-bit fixed-base windows: table[w][d] = [d * 16^w]B."""
+        """[s]B via 8-bit fixed-base windows: table[w][d] = [d * 256^w]B.
+
+        32 windows x 256 entries (~8k one-time point adds, amortised after
+        a few hundred signatures) halve the per-call adds vs the earlier
+        4-bit table; the walk is 32 adds for a full 255-bit scalar."""
         from .tpu import ed25519_ref as ref
 
         if cls._BASE_WINDOWS is None:
             windows = []
             base = ref.G
-            for _ in range(64):
+            for _ in range(32):
                 row = [ref.IDENTITY]
-                for _ in range(15):
+                for _ in range(255):
                     row.append(ref.point_add(row[-1], base))
                 windows.append(row)
                 base = row[1]
-                for _ in range(4):
+                for _ in range(8):
                     base = ref.point_double(base)
             cls._BASE_WINDOWS = windows
         acc = ref.IDENTITY
         w = 0
         while s > 0:
-            acc = ref.point_add(acc, cls._BASE_WINDOWS[w][s & 15])
-            s >>= 4
+            acc = ref.point_add(acc, cls._BASE_WINDOWS[w][s & 255])
+            s >>= 8
             w += 1
         return acc
 
@@ -163,7 +169,16 @@ class KeyPair:
         return KeyPair(public=_raw_public(priv.public_key()), _private=priv)
 
     def sign(self, message: bytes) -> bytes:
-        return self._private.sign(message)
+        signature = self._private.sign(message)
+        # A freshly produced signature is valid by construction, so seed the
+        # process-wide verified-signature cache with it. Under simnet every
+        # hosted peer verifies this exact (pk, msg, sig) triple; seeding at
+        # sign time turns all of those into cache hits — the co-hosted
+        # crypto plane's "verify a broadcast once per process, and never
+        # when the signer lives here". Same size guard as verify().
+        if len(message) <= _VERIFY_CACHE_MAX_MSG:
+            _VERIFY_CACHE.put((self.public, message, signature), True)
+        return signature
 
     def private_bytes(self) -> bytes:
         if isinstance(self._private, _RefPrivateKey):
@@ -241,19 +256,27 @@ BatchItem = tuple[bytes, bytes, bytes]
 BatchVerifier = Callable[[Sequence[BatchItem]], list[bool]]
 
 
-# Per-public-key window tables for the fallback verifier: a committee is a
-# handful of keys each verified thousands of times, so the one-time ~1.2k
-# group ops per key turn every subsequent [k](-A) into a 64-add table walk
-# (~3x faster verification). Entry-bounded: tables are ~100 KB each.
+# Per-public-key verifier state for the fallback verifier: a committee is
+# a handful of keys each verified thousands of times, so the one-time
+# ~1.2k group ops per key turn every subsequent [k](-A) into a 64-add
+# table walk (~3x faster verification), and caching the decompressed
+# point alongside skips the per-call field exponentiation that
+# `decompress` costs. Entry-bounded: tables are ~100 KB each.
 _REF_PK_WINDOWS = BoundedCache(max_entries=256)
 
 
-def _ref_neg_pk_windows(public_key: bytes, a):
-    """4-bit fixed-base windows of -A: table[w][d] = [d * 16^w](-A)."""
+def _ref_pk_entry(public_key: bytes):
+    """(decompressed A, 4-bit windows of -A) for a public key, cached.
+
+    Returns None for a key that does not decompress to a curve point.
+    The window table is table[w][d] = [d * 16^w](-A)."""
     from .tpu import ed25519_ref as ref
 
-    tab = _REF_PK_WINDOWS.get(public_key)
-    if tab is None:
+    entry = _REF_PK_WINDOWS.get(public_key)
+    if entry is None:
+        a = ref.decompress(public_key)
+        if a is None:
+            return None
         windows = []
         base = ref.point_neg(a)
         for _ in range(64):
@@ -263,9 +286,15 @@ def _ref_neg_pk_windows(public_key: bytes, a):
             windows.append(row)
             for _ in range(4):
                 base = ref.point_double(base)
-        tab = windows
-        _REF_PK_WINDOWS.put(public_key, tab)
-    return tab
+        entry = (a, windows)
+        _REF_PK_WINDOWS.put(public_key, entry)
+    return entry
+
+
+def _ref_neg_pk_windows(public_key: bytes, a=None):
+    """4-bit fixed-base windows of -A: table[w][d] = [d * 16^w](-A)."""
+    entry = _ref_pk_entry(public_key)
+    return entry[1] if entry is not None else None
 
 
 def _ref_verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
@@ -277,8 +306,8 @@ def _ref_verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
 
     if len(public_key) != 32 or len(signature) != 64:
         return False
-    a = ref.decompress(public_key)
-    if a is None:
+    entry = _ref_pk_entry(public_key)
+    if entry is None:
         return False
     rs, sb = signature[:32], signature[32:]
     s = int.from_bytes(sb, "little")
@@ -287,7 +316,7 @@ def _ref_verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
     if (int.from_bytes(rs, "little") & ((1 << 255) - 1)) >= ref.P:
         return False
     k = ref.sha512_mod_l(rs, public_key, message)
-    tab = _ref_neg_pk_windows(public_key, a)
+    tab = entry[1]
     rhs = _RefPrivateKey._g_mul(s)
     w = 0
     while k > 0:

@@ -39,9 +39,11 @@ class VotesAggregator:
             if self.cert_format == "compact":
                 # Half-aggregate: ~32 bytes/signer instead of 64, and the
                 # proof verifies as one msm-kernel equation (types.py
-                # Certificate docstring; Parameters.cert_format).
+                # Certificate docstring; Parameters.cert_format). Passing
+                # the committee lets assembly pre-seed the aggregate
+                # verdict cache when every vote is already known-valid.
                 return Certificate.compact_from_votes(
-                    header, tuple(signers), tuple(sigs)
+                    header, tuple(signers), tuple(sigs), committee=committee
                 )
             return Certificate(header, tuple(signers), tuple(sigs))
         return None

@@ -74,6 +74,7 @@ class VerifierStage:
 
     async def _verify(self, msg) -> None:
         agg_group = None
+        agg_committee = None
         try:
             if isinstance(msg, Header):
                 msg.verify(self.committee, self.worker_cache, check_signature=False)
@@ -83,14 +84,36 @@ class VerifierStage:
                 items = [msg.signature_item()]
             elif isinstance(msg, Certificate) and msg.is_compact:
                 # Half-aggregated proof: one aggregate check for the vote
-                # quorum + the embedded header's own signature.
-                agg_group = msg.aggregate_group(self.committee)
+                # quorum + the embedded header's own signature. The
+                # content-keyed front cache short-circuits the transcript
+                # rebuild (Fiat-Shamir weights + per-signer vote digests)
+                # whenever any co-hosted node — or an earlier relay copy
+                # arriving at this one — already decided this exact proof
+                # under this committee. Structural checks always run, so
+                # the InvalidEpoch/DagError semantics below are unchanged.
+                agg_committee = self.committee
+                verdict = msg.cached_aggregate_verdict(agg_committee)
                 items = []
-                if agg_group is not None:
-                    msg.header.verify(
-                        self.committee, self.worker_cache, check_signature=False
-                    )
-                    items.append(msg.header.signature_item())
+                if verdict is not None:
+                    msg.structural_verify(agg_committee)
+                    if not verdict:
+                        logger.debug(
+                            "verifier stage dropped compact certificate with "
+                            "known-bad aggregate proof"
+                        )
+                        return
+                    if not msg.is_genesis():
+                        msg.header.verify(
+                            agg_committee, self.worker_cache, check_signature=False
+                        )
+                        items.append(msg.header.signature_item())
+                else:
+                    agg_group = msg.aggregate_group(agg_committee)
+                    if agg_group is not None:
+                        msg.header.verify(
+                            agg_committee, self.worker_cache, check_signature=False
+                        )
+                        items.append(msg.header.signature_item())
             elif isinstance(msg, Certificate):
                 items = msg.verify_items(self.committee)
                 if items:
@@ -130,6 +153,11 @@ class VerifierStage:
                     type(msg).__name__,
                 )
                 return
+            if agg_group is not None:
+                # Publish the paid-for MSM verdict under the front key so
+                # every later copy of this certificate — same node's relay
+                # duplicates or a co-hosted peer's — skips the transcript.
+                msg.record_aggregate_verdict(agg_committee, bool(results[-1]))
             if not all(results):
                 logger.warning(
                     "verifier stage rejected %s with bad signature",

@@ -23,7 +23,7 @@ See README § "Fault simulation" for the grammar, oracle semantics, and the
 determinism guarantees.
 """
 
-from . import oracles
+from . import fuzz, oracles
 from .byzantine import Equivocator
 from .clock import SimDeadlockError, SimLoop
 from .cluster import SimCluster, node_id
@@ -57,6 +57,7 @@ __all__ = [
     "SimFabric",
     "SimLoop",
     "WorkerLoss",
+    "fuzz",
     "node_id",
     "oracles",
     "run_scenario",

@@ -10,9 +10,15 @@ changes.
 
 Delivery model:
 
-* every `writer.write(chunk)` schedules the chunk into the peer's reader at
-  `now + latency + jitter` (seeded RNG), clamped non-decreasing per
-  direction so the byte stream stays ordered, like TCP;
+* every `writer.write(chunk)` enqueues the chunk for delivery into the
+  peer's reader at `now + latency + jitter` (seeded RNG), clamped
+  non-decreasing per direction so the byte stream stays ordered, like TCP.
+  Deliveries are BATCHED: the fabric keeps one pending min-heap ordered by
+  (deliver_t, enqueue seq) and arms a single loop timer at the head
+  deadline — when it fires, every chunk due at that virtual instant drains
+  in one flush, with consecutive same-stream chunks coalesced into one
+  `feed_data`. One timer per flush instead of one per chunk is where the
+  10x on the asyncio_loop/timer-churn profile line comes from;
 * a `drop` hit kills the connection (both readers see ConnectionResetError)
   — on a framed, nonce-sequenced stream a lost segment is unrecoverable, so
   reset-and-reconnect is the honest model of a lossy link;
@@ -38,10 +44,16 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import hashlib
+import heapq
 import itertools
 import random
 
 from .plan import LinkSpec
+
+# Pending-queue entry kinds, ordered within a flush by (deliver_t, seq):
+# data chunks, graceful EOFs and drop-resets all ride the same queue so a
+# half-close or a mid-flight reset can never overtake bytes sent before it.
+_DATA, _EOF, _RESET = 0, 1, 2
 
 # The node id on whose behalf the current task opens connections. Set by
 # SimCluster around node construction/spawn; inherited by every task those
@@ -110,6 +122,10 @@ class _Listener:
 class _SimWriter:
     """Duck-typed StreamWriter over the fabric: write() hands the chunk to
     the fabric for conditioned delivery into the peer's reader."""
+
+    # No kernel send buffer behind this writer, so drain() never blocks —
+    # FrameSender uses this flag to write synchronously (no drainer task).
+    sync_drain = True
 
     def __init__(self, conn: "_SimConnection", direction: int):
         self._conn = conn
@@ -193,27 +209,27 @@ class _SimConnection:
             self.closed[direction] = True
             return
         self.closed[direction] = True
-        peer_reader = self.readers[direction]
-
-        def _eof() -> None:
-            if (
-                self.reset_exc is None
-                and peer_reader.exception() is None
-                and not peer_reader.at_eof()
-            ):
-                peer_reader.feed_eof()
-
-        # EOF rides strictly behind any chunks still in flight on this
-        # direction (same non-FIFO-heap hazard as data chunks).
+        # EOF rides the fabric's pending queue behind any chunks still in
+        # flight on this direction (queue order is (deliver_t, seq), so an
+        # equal-deadline EOF still lands after earlier-enqueued data).
         try:
             loop = asyncio.get_event_loop()
-            eof_t = max(loop.time(), self._next_deliver[direction] + 1e-9)
+            eof_t = max(loop.time(), self._next_deliver[direction])
             self._next_deliver[direction] = eof_t
-            loop.call_at(eof_t, _eof)
+            self.fabric._schedule(loop, eof_t, _EOF, self, direction, None)
         except RuntimeError:  # closing outside any loop (test teardown)
-            _eof()
+            self._feed_eof(direction)
         if all(self.closed):
             self.fabric._conns.discard(self)
+
+    def _feed_eof(self, direction: int) -> None:
+        reader = self.readers[direction]
+        if (
+            self.reset_exc is None
+            and reader.exception() is None
+            and not reader.at_eof()
+        ):
+            reader.feed_eof()
 
 
 class SimFabric:
@@ -242,6 +258,13 @@ class SimFabric:
             "resets": 0,
         }
         SimFabric.last_counters = self.counters
+        # Batched delivery: one min-heap of (deliver_t, seq, kind, conn,
+        # direction, payload) and ONE armed loop timer at the head
+        # deadline, instead of one loop timer per in-flight chunk.
+        self._pending: list[tuple] = []
+        self._pending_seq = itertools.count()
+        self._timer = None
+        self._timer_when = 0.0
         self._listeners: dict[str, _Listener] = {}
         self._conns: set[_SimConnection] = set()
         self._conn_ids = itertools.count(1)
@@ -382,17 +405,19 @@ class SimFabric:
             deliver_t = max(
                 now + link.latency, conn._next_deliver[direction]
             )
-            loop.call_at(deliver_t, conn.reset, "chunk dropped")
+            self._schedule(loop, deliver_t, _RESET, conn, direction, "chunk dropped")
             return
         jitter = self.rng.uniform(0.0, link.jitter) if link.jitter else 0.0
         deliver_t = now + link.latency + jitter
-        # STRICTLY increasing per direction: asyncio's timer heap is not
-        # FIFO for equal deadlines, so two chunks delivered at the same
-        # instant could swap — mid-frame, that shreds the byte stream. The
-        # nanosecond bump keeps ordering without measurable skew.
+        # Non-decreasing per direction (the TCP-like ordering cursor). The
+        # pending queue breaks equal-deadline ties by enqueue sequence, so
+        # chunks sharing a virtual instant still deliver in send order —
+        # and share one timer flush instead of one timer each (the old
+        # design needed a strictly-increasing nanosecond bump because
+        # asyncio's timer heap is not FIFO for equal deadlines).
         prev = conn._next_deliver[direction]
-        if deliver_t <= prev:
-            deliver_t = prev + 1e-9
+        if deliver_t < prev:
+            deliver_t = prev
         conn._next_deliver[direction] = deliver_t
         self.log.append(
             "xmit", conn.id, src, dst, len(data),
@@ -400,16 +425,64 @@ class SimFabric:
         )
         self.counters["transmits"] += 1
         self.counters["bytes_sent"] += len(data)
-        loop.call_at(deliver_t, self._deliver, conn, direction, data)
+        self._schedule(loop, deliver_t, _DATA, conn, direction, data)
 
-    @staticmethod
-    def _deliver(conn: _SimConnection, direction: int, data: bytes) -> None:
+    def _schedule(self, loop, when: float, kind: int, conn, direction: int, payload) -> None:
+        heapq.heappush(
+            self._pending,
+            (when, next(self._pending_seq), kind, conn, direction, payload),
+        )
+        if self._timer is None or when < self._timer_when:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer_when = when
+            self._timer = loop.call_at(when, self._flush)
+
+    def _flush(self) -> None:
+        """Drain every pending entry due at (or before) the current virtual
+        instant, in (deliver_t, seq) order, coalescing consecutive chunks
+        of one stream into a single feed_data; then re-arm the timer for
+        the next head deadline."""
+        self._timer = None
+        loop = asyncio.get_event_loop()
+        # Tiny epsilon so float drift in the virtual clock can never leave
+        # the head entry perpetually "one tick in the future" (which would
+        # re-arm a zero-delay timer forever).
+        now = loop.time() + 1e-9
+        pending = self._pending
+        cur_conn = None
+        cur_dir = 0
+        chunks: list[bytes] = []
+        while pending and pending[0][0] <= now:
+            _t, _seq, kind, conn, direction, payload = heapq.heappop(pending)
+            if kind == _DATA and conn is cur_conn and direction == cur_dir:
+                chunks.append(payload)
+                continue
+            if chunks:
+                self._feed(cur_conn, cur_dir, chunks)
+                chunks = []
+            cur_conn = None
+            if kind == _DATA:
+                cur_conn, cur_dir = conn, direction
+                chunks = [payload]
+            elif kind == _EOF:
+                conn._feed_eof(direction)
+            else:  # _RESET (dropped chunk)
+                conn.reset(payload)
+        if chunks:
+            self._feed(cur_conn, cur_dir, chunks)
+        if pending:
+            self._timer_when = pending[0][0]
+            self._timer = loop.call_at(self._timer_when, self._flush)
+
+    def _feed(self, conn: _SimConnection, direction: int, chunks: list) -> None:
         if conn.reset_exc is not None:
             return
-        reader = conn.readers[0] if direction == 0 else conn.readers[1]
+        reader = conn.readers[direction]
         # at_eof() is False while buffered bytes remain, so check the flag
         # itself: once EOF is fed, nothing more may enter the stream.
         if reader.exception() is None and not getattr(reader, "_eof", False):
-            conn.fabric.counters["delivers"] += 1
-            conn.fabric.counters["bytes_delivered"] += len(data)
+            data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+            self.counters["delivers"] += len(chunks)
+            self.counters["bytes_delivered"] += len(data)
             reader.feed_data(data)

@@ -29,6 +29,7 @@ import random as _random
 import time as _wall
 from dataclasses import dataclass, field
 
+from .. import types as _types
 from ..network import NetworkClient, auth as _auth, transport
 from ..network.rpc import WireStats
 from ..messages import ReconfigureMsg, SubmitTransactionStreamMsg
@@ -122,6 +123,10 @@ def run_scenario(
         return out[:n]
 
     prev_entropy = _auth.set_entropy(seeded_entropy)
+    # Same contract for the batch verifier's outer combination weights
+    # (types.host_batch_verify_aggregates): seeded weights keep the group
+    # arithmetic of a replayed run bit-identical too.
+    prev_weights = _types.set_weight_entropy(seeded_entropy)
     t_wall = _wall.monotonic()
     try:
         result = loop.run_until_complete(
@@ -135,6 +140,7 @@ def run_scenario(
         return result
     finally:
         _auth.set_entropy(prev_entropy)
+        _types.set_weight_entropy(prev_weights)
         transport.uninstall()
         _cleanup(loop)
 

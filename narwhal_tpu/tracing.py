@@ -57,6 +57,24 @@ ARCHIVE: collections.deque = collections.deque(maxlen=64)
 # tracer lifetime to this module.
 _LIVE: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
 
+# Cluster-incarnation generation: successive in-process clusters reuse
+# node labels AND certificate digests (seeded fixtures), so a live-tracer
+# dump that mixed incarnations would stitch spans from a PRIOR cluster
+# into the current one (the diagnosed test_live_cluster_scrape flake).
+# Each tracer records the generation current at its construction;
+# `live_dumps`/`on_anomaly` only touch the current generation. Cluster
+# boot bumps this via `new_generation()`.
+_GENERATION: int = 0
+
+
+def new_generation() -> int:
+    """Start a new tracer incarnation; previously constructed tracers
+    become invisible to `live_dumps`/`on_anomaly` (their rings stay
+    reachable through direct references and the archive)."""
+    global _GENERATION
+    _GENERATION += 1
+    return _GENERATION
+
 
 def _env_flag(name: str, default: str = "0") -> bool:
     return os.environ.get(name, default) not in ("", "0", "false", "no")
@@ -71,7 +89,7 @@ class Tracer:
     every constructor."""
 
     __slots__ = ("node", "enabled", "events", "anomalies", "_threshold",
-                 "__weakref__")
+                 "generation", "__weakref__")
 
     def __init__(
         self,
@@ -94,6 +112,7 @@ class Tracer:
             ring = int(os.environ.get("NARWHAL_FLIGHT_RING", "4096"))
         self.events: collections.deque = collections.deque(maxlen=max(16, ring))
         self.anomalies: list[str] = []
+        self.generation = _GENERATION
         _LIVE.add(self)
 
     # -- hot path ----------------------------------------------------------
@@ -168,10 +187,16 @@ def _archive(dump: dict) -> None:
 
 
 def live_dumps(max_events: int | None = None) -> list[dict]:
-    """Dump every live tracer (all hosted nodes of an in-process
-    committee), stable-ordered by node label."""
+    """Dump every live tracer of the CURRENT cluster incarnation (all
+    hosted nodes of an in-process committee), stable-ordered by node
+    label. Tracers from a prior incarnation are excluded even while still
+    referenced — their spans describe a different cluster's history."""
     return sorted(
-        (t.dump(max_events) for t in _LIVE),
+        (
+            t.dump(max_events)
+            for t in _LIVE
+            if t.generation == _GENERATION
+        ),
         key=lambda d: d["node"],
     )
 
@@ -187,6 +212,8 @@ def on_anomaly(reason: str) -> list[dict]:
     commit-stall detector attaches to its report)."""
     dumps = []
     for t in list(_LIVE):
+        if t.generation != _GENERATION:
+            continue
         t.anomalies.append(reason)
         dumps.append(t.dump())
     for d in dumps:

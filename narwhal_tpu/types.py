@@ -560,6 +560,32 @@ def _verify_group_msm(
 _AGG_VERDICT_CACHE = BoundedCache(max_entries=1 << 15)
 
 
+# Entropy seam for the batched verifier's outer combination weights.
+# Production draws from os.urandom (the adversary must not predict the
+# weights); simnet's seeded scenarios install a deterministic stream so a
+# replayed run performs bit-identical group arithmetic — same contract as
+# `network.auth.set_entropy` for handshake nonces. The weights never
+# influence VERDICTS (a failed combined check bisects deterministically),
+# so this seam is about reproducible execution, not correctness.
+def _default_weight_entropy(n: int) -> bytes:
+    import os
+
+    return os.urandom(n)
+
+
+_weight_entropy = _default_weight_entropy
+
+
+def set_weight_entropy(fn) -> "object":
+    """Install an entropy source for the batch verifier's outer weights;
+    returns the previous source so callers can restore it (pass None to
+    reset to os.urandom)."""
+    global _weight_entropy
+    prev = _weight_entropy
+    _weight_entropy = fn if fn is not None else _default_weight_entropy
+    return prev
+
+
 def _aggregate_cache_key(
     items: list[tuple[bytes, bytes, bytes]], zs: list[int], agg_s: int
 ) -> bytes:
@@ -600,8 +626,6 @@ def host_batch_verify_aggregates(groups: list[AggregateGroup]) -> list[bool]:
     amplification rule, host edition). Groups with undecodable points are
     rejected before the combined dispatch. Results are memoized in the
     process-wide aggregate-verdict cache."""
-    import os as _os
-
     from .tpu import ed25519_ref as ref
 
     ok = [False] * len(groups)
@@ -630,7 +654,7 @@ def host_batch_verify_aggregates(groups: list[AggregateGroup]) -> list[bool]:
         by_point: dict[bytes, list] = {}
         sum_s = 0
         for _, rows, s_agg, _key in pending:
-            w = int.from_bytes(_os.urandom(16), "little")
+            w = int.from_bytes(_weight_entropy(16), "little")
             sum_s += w * s_agg
             for pkey, s, p in rows:
                 entry = by_point.get(pkey)
@@ -853,10 +877,22 @@ class Certificate:
         header: "Header",
         signers: tuple[int, ...],
         signatures: tuple[bytes, ...],
+        committee=None,
     ) -> "Certificate":
         """Half-aggregate a quorum of full 64-byte vote signatures into a
         compact certificate (the assembly-side counterpart of
-        `aggregate_group`; Parameters.cert_format="compact")."""
+        `aggregate_group`; Parameters.cert_format="compact").
+
+        When the assembling node passes its `committee`, the aggregate
+        verdict is pre-seeded into the process-wide cache IF every
+        constituent full signature is already known-valid (a True entry in
+        crypto's verified-signature cache — vote receipt verified them, or
+        a co-hosted signer seeded them at sign time). That is sound: a
+        strictly (cofactorless) valid signature satisfies
+        [s_i]B - [k_i]A_i - R_i == identity exactly, so any z-weighted sum
+        of valid equations satisfies the cofactored aggregate equation.
+        Every co-hosted peer's verify of this certificate then hits the
+        cache instead of paying the MSM."""
         from .tpu.ed25519_ref import L
 
         rs = tuple(sig[:32] for sig in signatures)
@@ -864,10 +900,82 @@ class Certificate:
         agg = 0
         for z, sig in zip(zs, signatures):
             agg += z * int.from_bytes(sig[32:64], "little")
-        return Certificate(header, signers, rs, (agg % L).to_bytes(32, "little"))
+        cert = Certificate(header, signers, rs, (agg % L).to_bytes(32, "little"))
+        if committee is not None:
+            cert._seed_aggregate_verdict(committee, signatures)
+        return cert
+
+    def aggregate_proof_key(self, committee) -> bytes:
+        """Content key for the aggregate-verdict FRONT cache: one hash
+        over the certificate's raw proof fields plus the committee's
+        memoized transcript digest. The proof verdict is a pure function
+        of exactly these inputs (the Fiat-Shamir weights and every vote
+        message derive from them), so equal keys mean equal verdicts —
+        but unlike `_aggregate_cache_key` this never rebuilds the
+        per-signer transcript, so a cache HIT costs O(certificate bytes)
+        hashing instead of O(signers) vote-digest/weight recomputation.
+        At co-hosting scale that is the difference: every hosted peer
+        (and every relay duplicate) of a broadcast pays one flat hash."""
+        from .crypto import digest256
+
+        parts = [
+            b"narwhal-agg-front-v1",
+            committee.transcript_digest(),
+            self.header.digest,
+            int(self.round).to_bytes(8, "little"),
+            int(self.epoch).to_bytes(8, "little"),
+            self.origin,
+            len(self.signers).to_bytes(4, "little"),
+        ]
+        parts.extend(int(i).to_bytes(4, "little") for i in self.signers)
+        parts.extend(self.signatures)
+        parts.append(self.agg_s)
+        return digest256(b"".join(parts))
+
+    def cached_aggregate_verdict(self, committee) -> bool | None:
+        """Process-wide known verdict for this compact proof under this
+        committee, or None. True/False only certify the PROOF MATH —
+        callers still run the structural checks (`_signer_checks`) and
+        the header's own verification."""
+        return _AGG_VERDICT_CACHE.get(self.aggregate_proof_key(committee))
+
+    def record_aggregate_verdict(self, committee, verdict: bool) -> None:
+        """Publish a decided proof verdict under the front key (called by
+        whoever paid for the MSM: the verifier stage, `verify`, or the
+        assembler's seeding path)."""
+        _AGG_VERDICT_CACHE.put(self.aggregate_proof_key(committee), bool(verdict))
+
+    def _seed_aggregate_verdict(self, committee, full_signatures) -> None:
+        from .crypto import _VERIFY_CACHE
+
+        try:
+            group = self.aggregate_group(committee)
+        except DagError:
+            return
+        if group is None:
+            return
+        items, zs, s_agg = group
+        for (pk, msg, _r), sig in zip(items, full_signatures):
+            if _VERIFY_CACHE.get((pk, msg, sig)) is not True:
+                return
+        _AGG_VERDICT_CACHE.put(_aggregate_cache_key(items, zs, s_agg), True)
+        self.record_aggregate_verdict(committee, True)
 
     def verify(self, committee, worker_cache) -> None:
         if self.is_compact:
+            verdict = self.cached_aggregate_verdict(committee)
+            if verdict is not None:
+                # Front-cache hit: the proof math for this exact
+                # (certificate content, committee) pair is already decided
+                # somewhere in the process. Structural checks and the
+                # header's own verification still run — only the
+                # per-signer transcript rebuild and the MSM are skipped.
+                if self._signer_checks(committee) is None:
+                    return
+                self.header.verify(committee, worker_cache)
+                if not verdict:
+                    raise InvalidSignatureError("aggregate certificate proof invalid")
+                return
             group = self.aggregate_group(committee)
             if group is None:
                 return
@@ -877,7 +985,9 @@ class Certificate:
             # and shared with every co-hosted node via the process-wide
             # aggregate-verdict cache — the Core's loopback re-verification
             # of block-synchronizer fetches becomes a cache hit.
-            if not host_batch_verify_aggregates([group])[0]:
+            ok = host_batch_verify_aggregates([group])[0]
+            self.record_aggregate_verdict(committee, ok)
+            if not ok:
                 raise InvalidSignatureError("aggregate certificate proof invalid")
             return
         items = self.verify_items(committee)

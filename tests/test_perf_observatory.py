@@ -120,8 +120,11 @@ def test_ledger_append_read_roundtrip(tmp_path, monkeypatch):
     )
     assert rec is not None and rec["kind"] == "microbench"
     ledger.append("ab", {"legs": []}, verdict={"verdict": "win"})
+    # "fuzz" is a registered kind (deliberate KINDS extension): one record
+    # per FaultPlan-fuzzer campaign, payload = the campaign summary.
+    ledger.append("fuzz", {"count": 3, "ok": True, "failures": []})
     records = ledger.read_ledger(path)
-    assert [r["kind"] for r in records] == ["microbench", "ab"]
+    assert [r["kind"] for r in records] == ["microbench", "ab", "fuzz"]
     assert records[0]["argv"] == ["--fast"]
     assert records[1]["verdict"]["verdict"] == "win"
     # Every appended record carries the host calibration it measured under.

@@ -261,7 +261,16 @@ def test_partial_committee_change_deterministic_simnet(monkeypatch):
                 "commit" in v["stages"] and "certify" in v["stages"]
                 for v in falls.values()
             )
-            assert any(epoch == 1 for epoch, _, _ in cluster.commits[0])
+            # Round progress and commit delivery race by a few virtual
+            # instants: node 0 can hold epoch-1 round-4 certificates while
+            # its consensus task is still queued in the same instant. Wait
+            # (virtual seconds, free) instead of snapshotting immediately.
+            deadline = asyncio.get_event_loop().time() + 60.0
+            while not any(epoch == 1 for epoch, _, _ in cluster.commits[0]):
+                assert (
+                    asyncio.get_event_loop().time() < deadline
+                ), "node 0 never committed in epoch 1"
+                await asyncio.sleep(0.1)
         finally:
             for client in clients:
                 client.close()

@@ -209,6 +209,33 @@ def test_anomaly_archives_every_live_ring():
     assert len(tracing.ARCHIVE) == 0
 
 
+def test_tracer_registry_is_scoped_per_cluster_incarnation():
+    """A tracer from a previous cluster incarnation kept alive (a leaked
+    ring, a node a test forgot to drop) must not bleed spans into the next
+    incarnation's live view: successive in-process clusters reuse node
+    labels and — with seeded fixtures — certificate digests, so without
+    generation scoping `live_dumps()` merged a prior cluster's spans into
+    the next one's waterfalls (the live-cluster waterfall test's flake)."""
+    from narwhal_tpu.cluster import Cluster
+
+    stale = Tracer(node="primary-0", enabled=True, sample=1.0, ring=32)
+    stale.span("commit", b"\x07" * 32, 0.0, 1.0)
+    assert any(
+        d["node"] == "primary-0" and d["events"] for d in tracing.live_dumps()
+    )
+
+    # Constructing the cluster opens the new incarnation; no boot needed.
+    Cluster(size=4, workers=1)
+    assert not any(
+        d["node"] == "primary-0" and d["events"] for d in tracing.live_dumps()
+    )
+    # Anomaly snapshots are scoped the same way: the stale ring is neither
+    # archived nor tagged.
+    tracing.on_anomaly("incarnation test")
+    assert "incarnation test" not in stale.anomalies
+    tracing.clear_archive()
+
+
 # ---------------------------------------------------------------------------
 # The Telemetry RPC pair over the simnet fabric (zero sockets)
 # ---------------------------------------------------------------------------

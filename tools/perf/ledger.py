@@ -49,6 +49,7 @@ KINDS = frozenset(
         "ab",
         "simnet_profile",
         "epilogue_profile",
+        "fuzz",
     }
 )
 
