@@ -56,7 +56,9 @@ class BenchmarkClient:
         # random filler bytes, benchmark_client.rs).
         import secrets
 
-        self._nonce = secrets.token_bytes(8)
+        # Load-generator CLI, not protocol code: the nonce only needs to be
+        # unique per client process and is never replayed under a seed.
+        self._nonce = secrets.token_bytes(8)  # lint: allow(raw-entropy)
 
     async def wait_for_nodes(self, timeout: float = 30.0) -> None:
         """Wait until every node's tx port accepts connections

@@ -151,7 +151,9 @@ class KeyPair:
     @staticmethod
     def generate() -> "KeyPair":
         if not HAVE_OPENSSL:
-            return KeyPair.from_seed(os.urandom(32))
+            # Boot-time identity keygen: seeded scenarios derive keypairs
+            # from the plan seed via from_seed and never call generate().
+            return KeyPair.from_seed(os.urandom(32))  # lint: allow(raw-entropy)
         priv = Ed25519PrivateKey.generate()
         return KeyPair(public=_raw_public(priv.public_key()), _private=priv)
 
@@ -204,7 +206,11 @@ def _pub(public_key: bytes) -> Ed25519PublicKey:
     if obj is None:
         obj = Ed25519PublicKey.from_public_bytes(public_key)
         if len(_PUB_CACHE) < 1 << 16:
-            _PUB_CACHE[public_key] = obj
+            # Process-wide decode cache, deliberately shared across every
+            # co-hosted node: the value is a pure function of the key bytes,
+            # so lost updates and cross-node hits are both benign, and the
+            # single-statement insert is atomic under cooperative scheduling.
+            _PUB_CACHE[public_key] = obj  # lint: allow(multi-task-mutation)
     return obj
 
 

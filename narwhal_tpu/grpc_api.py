@@ -105,6 +105,12 @@ class GrpcPublicApi:
         self._server: grpc.aio.Server | None = None
         self.address: str = ""
 
+    def set_primary_address(self, address: str) -> None:
+        """Single write seam for the advertised primary address: the
+        bound (possibly ephemeral) port only exists after Primary.spawn,
+        so Node installs it here rather than poking the attribute."""
+        self.primary_address = address
+
     # -- Validator ---------------------------------------------------------
     async def _get_collections(self, request, context):
         from .primary.block_waiter import BlockError, BlockResponse

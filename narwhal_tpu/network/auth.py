@@ -134,7 +134,11 @@ class _RefX25519PrivateKey:
 
     @staticmethod
     def generate() -> "_RefX25519PrivateKey":
-        return _RefX25519PrivateKey(os.urandom(32))
+        # Draw through the module entropy seam (resolved at call time, so
+        # set_entropy() installed later still governs): when the reference
+        # backend is aliased as X25519PrivateKey, seeded scenarios must get
+        # deterministic ephemeral keys here too.
+        return _RefX25519PrivateKey(_entropy(32))
 
     def public_key(self) -> _RefX25519PublicKey:
         return _RefX25519PublicKey(self._pub)

@@ -94,7 +94,11 @@ class RetryConfig:
         delay = self.initial
         elapsed = 0.0
         while True:
-            d = delay * (1.0 + random.uniform(-self.jitter, self.jitter))
+            # Reconnect jitter rides the scenario-seeded global stream
+            # (scenario.py seeds `random` per plan), so replays see the
+            # same backoff schedule; outside simnet jitter spread is the
+            # entire point and determinism is irrelevant.
+            d = delay * (1.0 + random.uniform(-self.jitter, self.jitter))  # lint: allow(unseeded-random)
             yield d
             elapsed += d
             if self.max_elapsed is not None and elapsed >= self.max_elapsed:
@@ -366,7 +370,10 @@ class FrameSender:
                         self._counters,
                     )
                 WireStats.record_drain(len(batch))
-                parts = buf.parts
+                # _FrameBuffer is a per-drain local scratch buffer: created,
+                # filled and read inside this one call frame (creator
+                # pattern) — the class is shared, the instance never is.
+                parts = buf.parts  # lint: allow(multi-task-mutation)
                 self._writer.write(
                     parts[0] if len(parts) == 1 else b"".join(parts)
                 )
@@ -391,7 +398,10 @@ class FrameSender:
                 await self._writer.drain()
         except (ConnectionError, OSError) as e:
             self._closed = True
-            self._queue.clear()
+            # Connection is dead: frames enqueued during the failed drain
+            # are deliberately dropped with it (there is nowhere to send
+            # them) — losing a concurrent enqueue here is the semantics.
+            self._queue.clear()  # lint: allow(await-interleaved-rmw)
             if self._on_error is not None:
                 self._on_error(e)
 
@@ -456,7 +466,10 @@ class PeerClient:
                     writer.close()
                     raise RpcError(f"handshake with {self.address} failed: {e}") from e
             self._session = session
-            self._writer = writer
+            # The whole connect sequence is serialized by self._lock (with
+            # an early return when another task won the race), so this
+            # check-then-act cannot interleave with a second connect.
+            self._writer = writer  # lint: allow(await-interleaved-rmw)
             self._sender = FrameSender(
                 writer,
                 session,
@@ -553,7 +566,9 @@ class PeerClient:
             self._sender.send(KIND_REQ, rid, tag, body)
             return await asyncio.wait_for(fut, timeout)
         except (ConnectionError, OSError) as e:
-            self._pending.pop(rid, None)
+            # Register/await/cleanup idiom: each task pops only the rid it
+            # registered itself — concurrent requests touch disjoint keys.
+            self._pending.pop(rid, None)  # lint: allow(await-interleaved-rmw)
             self._teardown(RpcError(str(e)))
             raise RpcError(f"send to {self.address} failed: {e}") from e
         except RpcError:
@@ -730,7 +745,9 @@ class RpcServer:
         except (asyncio.IncompleteReadError, ConnectionError, OSError, RpcError, AuthError) as e:
             logger.debug("peer %s disconnected: %r", peer_addr, e)
         finally:
-            self._writers.discard(writer)
+            # Each connection task discards only its own writer (added once
+            # at accept): concurrent connections touch disjoint elements.
+            self._writers.discard(writer)  # lint: allow(await-interleaved-rmw)
             if sender is not None:
                 sender.close()
             for t in tasks:
@@ -909,7 +926,10 @@ class NetworkClient:
 
     async def lucky_broadcast(self, addresses: list[str], msg, nodes: int) -> list[bool]:
         """Random-subset broadcast (LuckyNetwork, traits.rs:70-94)."""
-        chosen = random.sample(addresses, min(nodes, len(addresses)))
+        # Deliberate draw from the scenario-seeded global stream
+        # (scenario.py seeds `random` per plan): the "lucky" subset is
+        # meant to be random AND replayable under the same seed.
+        chosen = random.sample(addresses, min(nodes, len(addresses)))  # lint: allow(unseeded-random)
         return await self.unreliable_broadcast(chosen, msg)
 
     def close(self) -> None:

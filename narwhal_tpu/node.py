@@ -472,12 +472,12 @@ class PrimaryNode:
         # meaningless inside a simulation anyway).
         from .network import transport as _transport
 
-        self.api.primary_address = self.primary.address
+        self.api.set_primary_address(self.primary.address)
         self.api_address = await self.api.spawn("127.0.0.1:0")
         if _transport.simnet_active():
             self.grpc_api_address = ""
         else:
-            self.grpc_api.primary_address = self.primary.address
+            self.grpc_api.set_primary_address(self.primary.address)
             self.grpc_api_address = await self.grpc_api.spawn(
                 self.parameters.consensus_api_grpc_address
             )
@@ -561,7 +561,10 @@ class PrimaryNode:
             stale = (clock.now() - last_commit_t) if committed > 0 else None
             level = backpressure_level(
                 (ch.occupancy() for ch in channels),
-                commit_timer.ewma,
+                # Monitoring read of the stage timers' EWMA: a one-tick
+                # stale value only delays the admission level by one poll
+                # interval — racy-read-tolerant by design.
+                commit_timer.ewma,  # lint: allow(multi-task-mutation)
                 stale,
                 target,
                 self.parameters.backpressure_high_watermark,

@@ -275,7 +275,15 @@ class StageTimer:
     single `close()` both emits the causal span (when tracing is enabled
     and the key samples in) and observes the histogram, so the stage
     histograms are derived from span closes by construction — no double
-    bookkeeping, and the equivalence is pinned by test."""
+    bookkeeping, and the equivalence is pinned by test.
+
+    One span window per key: once a key closes, a later `start()` for the
+    same key is a no-op (bounded recently-closed latch). Without this, a
+    straggler re-propose/re-deliver after the stage already closed mints
+    a SECOND, later span for the same key — and if the first span has
+    been evicted from the trace ring, the waterfall's earliest-t0 rule
+    picks the bogus window, producing causality inversions (a certify
+    span that "starts" after its own commit)."""
 
     def __init__(
         self,
@@ -285,12 +293,15 @@ class StageTimer:
         clock: Callable[[], float] = _now,
         ewma_alpha: float = 0.2,
         tracer=None,  # tracing.Tracer: span sink for this stage's closes
+        max_closed: int = 4096,
     ):
         self._child = histogram.labels(stage)
         self._stage = stage
         self._max = max_pending
         self._clock = clock
         self._pending: dict = {}
+        self._closed: dict = {}  # insertion-ordered set of closed keys
+        self._max_closed = max_closed
         self._tracer = tracer
         # Recent-latency EWMA alongside the histogram: the histogram's
         # sum/count is a lifetime mean, useless as a control signal — the
@@ -302,6 +313,8 @@ class StageTimer:
         pending = self._pending
         if key in pending:
             return  # first sighting wins; re-delivery must not reset t0
+        if key in self._closed:
+            return  # one span window per key; no re-open after close
         while len(pending) >= self._max:
             pending.pop(next(iter(pending)))
         pending[key] = self._clock()
@@ -312,11 +325,20 @@ class StageTimer:
             return None
         return self.close(key, t0)
 
+    def _latch_closed(self, key) -> None:
+        closed = self._closed
+        if key in closed:
+            return
+        while len(closed) >= self._max_closed:
+            closed.pop(next(iter(closed)))
+        closed[key] = None
+
     def close(self, key, t0: float) -> float:
         """Close the stage span opened at t0 for `key`: emit the trace span
         and derive the histogram observation from the same close. Callers
         that learn the key only at the end of the stage (batch seal: the
         digest exists once the batch is sealed) call this directly."""
+        self._latch_closed(key)
         t1 = self._clock()
         tracer = self._tracer
         if (

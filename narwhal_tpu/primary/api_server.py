@@ -80,6 +80,12 @@ class ConsensusApi:
         load = getattr(self._committee, "load", None)
         return load() if load is not None else self._committee
 
+    def set_primary_address(self, address: str) -> None:
+        """Single write seam for the advertised primary address: the
+        bound (possibly ephemeral) port only exists after Primary.spawn,
+        so Node installs it here rather than poking the attribute."""
+        self.primary_address = address
+
     async def spawn(self, address: str) -> str:
         host, port = address.rsplit(":", 1)
         bound = await self.server.start(host, int(port))

@@ -60,7 +60,10 @@ class PeerScores:
 
     def __init__(self, rng: random.Random | None = None):
         self._scores: dict[PublicKey, float] = {}
-        self._rng = rng or random
+        # Falling back to the module means the scenario-seeded global
+        # stream under simnet (scenario.py seeds it per plan) — replayable;
+        # tests inject a dedicated random.Random for isolation.
+        self._rng = rng or random  # lint: allow(unseeded-random)
 
     def score(self, peer: PublicKey) -> float:
         return self._scores.get(peer, self.INITIAL)

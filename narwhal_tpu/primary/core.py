@@ -149,7 +149,10 @@ class Core:
             # since its previous header (the quantity the fanout tree +
             # delta encodings exist to shrink; MB/round from metrics, not
             # log scraping).
-            total = self.wire_counters.bytes_sent
+            # WireCounters are monotonic add-only tallies bumped by every
+            # sender task; a read interleaving with an add is off by one
+            # frame's bytes at worst — metrics-grade, not protocol state.
+            total = self.wire_counters.bytes_sent  # lint: allow(multi-task-mutation)
             self.metrics.round_egress_bytes.set(total - self._egress_at_last_header)
             self._egress_at_last_header = total
         self.delta_codec.note_own_header(header)

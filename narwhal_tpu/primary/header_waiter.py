@@ -158,7 +158,9 @@ class HeaderWaiter:
                 else:
                     # Timer retry: ask sync_retry_nodes random peers
                     # (header_waiter.rs:292-321).
-                    for addr in random.sample(
+                    # Deliberate draw from the scenario-seeded global
+                    # stream: retry-peer choice replays under the same seed.
+                    for addr in random.sample(  # lint: allow(unseeded-random)
                         others, min(self.parameters.sync_retry_nodes, len(others))
                     ):
                         await self._fetch_certificates(msg.missing, addr)

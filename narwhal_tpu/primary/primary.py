@@ -412,7 +412,10 @@ class Primary:
         lose the vote forever — fatal in a committee whose quorum needs
         every survivor. The reconstructed fields are covered by the vote
         signature, so a forged rebuild can only fail verification."""
-        header = self.core.current_header
+        # Atomic read of Core's latest proposed header (Core.run replaces
+        # the whole reference between awaits, never mutates in place); a
+        # mismatch just falls through to the store/waiter path below.
+        header = self.core.current_header  # lint: allow(multi-task-mutation)
         if header is None or header.digest != msg.header_digest:
             header = self.header_store.read(msg.header_digest)
         if header is None:

@@ -508,7 +508,11 @@ class ColumnFamily:
                 except ValueError:
                     pass
                 if not lst:
-                    self._waiters.pop(key, None)
+                    # Register/await/cleanup idiom: each waiter removes
+                    # only its own future, and the empty-list pop re-checks
+                    # the CURRENT list after the await — a waiter that
+                    # registered at the yield point repopulates the key.
+                    self._waiters.pop(key, None)  # lint: allow(await-interleaved-rmw)
 
     def _notify(self, key: bytes, value: bytes) -> None:
         for fut in self._waiters.pop(key, []):

@@ -539,7 +539,10 @@ class TpuBullshark:
         name = self.committee.leader(round)
         idx = self.committee.index_of(name)
         off = self.win._off(round)
-        if 0 <= off < self.win.W and self.win.present[off, idx]:
+        # DagWindow is mutated only by the Dag task's ingest/flush, never
+        # mid-yield; consensus reads tolerate a one-flush-stale window
+        # (absent leader just means "not present yet" — retried next round).
+        if 0 <= off < self.win.W and self.win.present[off, idx]:  # lint: allow(multi-task-mutation)
             return idx
         return None
 
@@ -554,7 +557,9 @@ class TpuBullshark:
             off = self.win._off(rr)
             if not (0 <= off < self.win.W):
                 return False
-            links = self.win.parent[off]  # [N, N]: (rr, a) -> (rr-1, p)
+            # Same discipline as above: Dag-task-only writes, stale-tolerant
+            # reads (missing links fail toward "not linked", retried later).
+            links = self.win.parent[off]  # lint: allow(multi-task-mutation)
             frontier = (links[frontier].any(axis=0)) & self.win.present[
                 self.win._off(rr - 1)
             ].astype(bool)

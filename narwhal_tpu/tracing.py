@@ -86,7 +86,19 @@ class Tracer:
     `enabled`/`sample`/`ring` default from the environment at construction
     (NARWHAL_TRACE, NARWHAL_TRACE_SAMPLE, NARWHAL_FLIGHT_RING) so a whole
     in-process committee flips together without plumbing flags through
-    every constructor."""
+    every constructor.
+
+    Concurrency discipline: many tasks append to `events` (every stage
+    timer close, every instant), and the live-dump RPC handler reads it
+    concurrently — the ring is safe because appends are single-statement
+    (atomic under cooperative scheduling: no await between deciding to
+    record and recording) and every reader snapshots copy-on-read
+    (`dump()` does `list(self.events)` and serializes BEFORE its caller's
+    next yield point). Do not hold a live reference to `events` across an
+    await. Span ordering sanity (one window per key per stage, so the
+    waterfall's earliest-t0 pick cannot land on a late re-opened window
+    after ring eviction) is the stage timers' job: see
+    pacing.StageTimer's closed-key latch."""
 
     __slots__ = ("node", "enabled", "events", "anomalies", "_threshold",
                  "generation", "__weakref__")

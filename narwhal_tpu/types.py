@@ -570,7 +570,9 @@ _AGG_VERDICT_CACHE = BoundedCache(max_entries=1 << 15)
 def _default_weight_entropy(n: int) -> bytes:
     import os
 
-    return os.urandom(n)
+    # This IS the seam's production default: seeded scenarios replace it
+    # via set_weight_entropy; everything else must draw through it.
+    return os.urandom(n)  # lint: allow(raw-entropy)
 
 
 _weight_entropy = _default_weight_entropy

@@ -1,0 +1,29 @@
+"""Clean twin of det_trip.py: the deterministic version of every shape.
+
+Must produce ZERO findings — pinned by test. Each method fixes its
+det_trip counterpart the way protocol code is expected to.
+"""
+
+import random
+
+
+class Broadcaster:
+    def __init__(self, rng: random.Random | None = None, seed: int = 0):
+        self.peers: set = set()
+        self.rng = rng if rng is not None else random.Random(seed)
+
+    def fresh_id(self, counter: int, node: str) -> str:
+        return f"{node}:{counter}"  # stable protocol identity
+
+    def jitter(self) -> float:
+        return self.rng.uniform(0.0, 1.0)  # injected seeded stream
+
+    def private_rng(self, seed: int):
+        return random.Random(seed)
+
+    def dedup_key(self, msg) -> bytes:
+        return msg.digest  # content-derived, replay-stable
+
+    def flood(self, msg) -> None:
+        for peer in sorted(self.peers, key=lambda p: p.name):
+            peer.send(msg)

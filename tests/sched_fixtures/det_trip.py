@@ -1,0 +1,31 @@
+"""Tripping fixture for the determinism family: one hit per shape.
+
+Each statement below is a distinct detector shape with a pinned count in
+tests/test_static_analysis.py — keep them one-per-line and update the
+pins when adding shapes.
+"""
+
+import random
+import uuid
+
+
+class Broadcaster:
+    def __init__(self, rng=None):
+        self.peers: set = set()
+        self.rng = rng or random  # unseeded-random: module object as RNG
+
+    def fresh_id(self) -> str:
+        return uuid.uuid4().hex  # raw-entropy
+
+    def jitter(self) -> float:
+        return random.uniform(0.0, 1.0)  # unseeded-random: global draw
+
+    def private_rng(self):
+        return random.Random()  # unseeded-random: no seed
+
+    def dedup_key(self, msg) -> int:
+        return id(msg)  # id-keyed-ordering
+
+    def flood(self, msg) -> None:
+        for peer in self.peers:  # unordered-iteration: effectful set loop
+            peer.send(msg)

@@ -1,0 +1,16 @@
+"""PR-9 regression fixture: os.urandom handshake nonces, verbatim shape.
+
+The auth handshake drew its anti-replay nonce straight from the OS, so
+the handshake transcript — and everything keyed off it — differed
+between two runs of the same seeded scenario. The fix routed the draw
+through the `auth.set_entropy` seam; this fixture pins that `raw-entropy`
+re-finds the original shape.
+"""
+
+import os
+
+
+def client_handshake(writer, static_key: bytes) -> bytes:
+    nonce = os.urandom(32)  # BUG (PR-9): ambient entropy in the handshake
+    writer.write(static_key + nonce)
+    return nonce

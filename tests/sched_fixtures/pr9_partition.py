@@ -1,0 +1,22 @@
+"""PR-9 regression fixture: the set_partition divergence, verbatim shape.
+
+The simnet fabric kept live connections in a `set` and reset them on a
+partition change by iterating it directly. Connection resets are
+observable wire effects, so two runs of the same seeded scenario reset
+in different (hash) orders and their logs diverged — found by hand A/B
+log diffing, now pinned as what `unordered-iteration` must re-find.
+"""
+
+
+class Fabric:
+    def __init__(self):
+        self._conns: set = set()
+        self._partition: tuple = ()
+
+    def register(self, conn) -> None:
+        self._conns.add(conn)
+
+    def set_partition(self, groups) -> None:
+        self._partition = tuple(tuple(sorted(g)) for g in groups)
+        for conn in self._conns:  # BUG (PR-9): hash-order resets
+            conn.reset(self._partition)

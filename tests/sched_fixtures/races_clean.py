@@ -1,0 +1,59 @@
+"""Clean twin of races_trip.py: the same two tasks, disciplined.
+
+All Board mutation flows through Board's own methods (one container, one
+encapsulation boundary) and Counter.bump does its read-modify-write
+atomically AFTER the yield point — zero findings, pinned by test.
+"""
+
+import asyncio
+
+
+class Board:
+    def __init__(self):
+        self.slots: dict = {}
+        self.total = 0
+
+    def post(self, key, value) -> None:
+        self.slots[key] = value
+        self.total += 1
+
+    def occupancy(self) -> int:
+        return len(self.slots)
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    async def bump(self) -> None:
+        await asyncio.sleep(0)
+        self.count += 1  # read and write on one side of the yield
+
+
+class Writer:
+    def __init__(self, board, counter):
+        self.board = board
+        self.counter = counter
+
+    async def run(self) -> None:
+        self.board.post("w", 1)
+        await self.counter.bump()
+
+
+class Reader:
+    def __init__(self, board, counter):
+        self.board = board
+        self.counter = counter
+
+    async def run(self) -> None:
+        self.board.post("r", self.board.occupancy())
+        await self.counter.bump()
+
+
+def main():
+    board = Board()
+    counter = Counter()
+    writer = Writer(board, counter)
+    reader = Reader(board, counter)
+    asyncio.create_task(writer.run())
+    asyncio.create_task(reader.run())

@@ -1,0 +1,61 @@
+"""Tripping fixture for the race family, driven from `main` as the
+extraction root (package='' so the program is just this file).
+
+Two independent shapes:
+
+* `Board` — unencapsulated sharing: Writer's task and Reader's task both
+  poke `board.slots` / `board.total` directly from their own class
+  bodies. Two containers, two writer tasks -> `multi-task-mutation`.
+* `Counter` — encapsulated but yield-unsafe: both tasks call
+  `Counter.bump`, whose read of `self.count` and write-back straddle an
+  await -> `await-interleaved-rmw` (a lost update, the classic shape).
+"""
+
+import asyncio
+
+
+class Board:
+    def __init__(self):
+        self.slots: dict = {}
+        self.total = 0
+
+
+class Counter:
+    def __init__(self):
+        self.count = 0
+
+    async def bump(self) -> None:
+        current = self.count
+        await asyncio.sleep(0)
+        self.count = current + 1
+
+
+class Writer:
+    def __init__(self, board, counter):
+        self.board = board
+        self.counter = counter
+
+    async def run(self) -> None:
+        self.board.slots["w"] = 1
+        self.board.total += 1
+        await self.counter.bump()
+
+
+class Reader:
+    def __init__(self, board, counter):
+        self.board = board
+        self.counter = counter
+
+    async def run(self) -> None:
+        seen = len(self.board.slots)
+        self.board.slots["r"] = seen
+        await self.counter.bump()
+
+
+def main():
+    board = Board()
+    counter = Counter()
+    writer = Writer(board, counter)
+    reader = Reader(board, counter)
+    asyncio.create_task(writer.run())
+    asyncio.create_task(reader.run())

@@ -255,3 +255,29 @@ def test_authenticated_server_is_deny_by_default():
     srv.route(SynchronizeMsg, lambda msg, peer: None, allow=lambda p: False)
     # Unauthenticated (public-plane) servers keep the permissive default.
     RpcServer().route(CleanupMsg, lambda msg, peer: None)
+
+
+def test_reference_keygen_draws_through_entropy_seam():
+    """`_RefX25519PrivateKey.generate` (the no-OpenSSL backend's ephemeral
+    keygen) must draw through the `set_entropy` seam, not os.urandom: when
+    the reference class is aliased as X25519PrivateKey, seeded scenarios
+    need deterministic ephemeral keys here too (the PR-9 nonce divergence,
+    one layer down)."""
+    from narwhal_tpu.network import auth
+
+    drawn = []
+
+    def fixed(n: int) -> bytes:
+        drawn.append(n)
+        return bytes(range(n))
+
+    prev = auth.set_entropy(fixed)
+    try:
+        k1 = auth._RefX25519PrivateKey.generate()
+        k2 = auth._RefX25519PrivateKey.generate()
+    finally:
+        auth.set_entropy(prev)
+    assert drawn == [32, 32]
+    assert k1._k == k2._k == bytes(range(32))
+    # Seam restored: generation is entropic again.
+    assert auth._RefX25519PrivateKey.generate()._k != k1._k

@@ -437,6 +437,36 @@ def test_stage_timer_records_and_bounds():
     assert t.stop(9) is not None
 
 
+def test_stage_timer_one_span_window_per_key():
+    """Once a key closes, a straggler re-start must NOT open a second,
+    later window: a re-propose/re-deliver after certify already closed
+    would otherwise mint a certify span with t0 past the commit, and —
+    once the true span ages out of the trace ring — invert the
+    waterfall's causality (the residual certify/commit race)."""
+    reg = Registry()
+    hist = reg.histogram("node_stage_latency_seconds", "", labels=("stage",))
+    now = [100.0]
+    t = StageTimer(hist, "certify", clock=lambda: now[0])
+    t.start("k")
+    now[0] = 100.5
+    assert t.stop("k") == pytest.approx(0.5)
+    # Straggler re-open long after the close: latched to a no-op.
+    now[0] = 104.0
+    t.start("k")
+    assert t.stop("k") is None
+    assert reg.value("node_stage_latency_seconds", "certify") == 1
+    # The latch is bounded: the oldest closed keys fall out and only
+    # then may a key legitimately open a fresh window.
+    t2 = StageTimer(hist, "certify", clock=lambda: now[0], max_closed=2)
+    for k in ("a", "b", "c"):
+        t2.start(k)
+        t2.stop(k)
+    t2.start("a")  # "a" evicted from the closed latch
+    assert t2.stop("a") is not None
+    t2.start("c")  # "c" still latched
+    assert t2.stop("c") is None
+
+
 # ---------------------------------------------------------------------------
 # Cluster: kwargs satellite + the stage pipeline end to end
 # ---------------------------------------------------------------------------

@@ -1,4 +1,7 @@
-"""The tier-1 static-analysis gates: narwhal-lint AND narwhal-topo.
+"""The tier-1 static-analysis gates: narwhal-lint, narwhal-topo AND
+narwhal-sched, driven through the combined `python -m tools.check`
+runner (one process, one shared whole-program extraction, one exit
+code).
 
 Part 1 (narwhal-lint): runs the per-function analyzer over `narwhal_tpu/`
 and `tests/` and fails on any non-baselined finding — this is how the
@@ -16,6 +19,14 @@ topology is pinned as a checked-in artifact (tools/analysis/topology.json
 + .dot): wiring changes without `python -m tools.analysis
 --write-artifact` fail the stale-artifact test, exactly like a stale lint
 baseline.
+
+Part 3 (narwhal-sched, tools/sched): interleaving races (multi-task
+mutation without a single-writer discipline, read-modify-write spanning
+an await) over the extractor's task-attributed state sites, plus the
+replay-determinism family (raw entropy beside the seeded seams, the
+global random stream, id()-keyed ordering, effectful set iteration) that
+machine-checks the two PR-9 divergences. Regression fixtures under
+tests/sched_fixtures/ pin both PR-9 bugs verbatim.
 """
 
 from __future__ import annotations
@@ -42,17 +53,24 @@ def lint(*paths, baseline=None, rules=None):
 
 
 # ---------------------------------------------------------------------------
-# The gate
+# The gate: ONE combined `tools.check` run feeds every tree-clean test
+# (lint + topo + sched share it; topo and sched share one extraction).
 # ---------------------------------------------------------------------------
 
+from tools.check import run_check  # noqa: E402
 
-def test_tree_has_no_new_findings():
-    """`python -m tools.lint narwhal_tpu/ tests/` must be clean modulo the
+
+@pytest.fixture(scope="module")
+def check_report():
+    return run_check(root=REPO)
+
+
+def test_tree_has_no_new_findings(check_report):
+    """`python -m tools.check` (lint plane) must be clean modulo the
     checked-in baseline. If this fails: fix the finding, suppress it with a
     justified `# lint: allow(<rule>)`, or (last resort) regenerate the
     baseline via `python -m tools.lint --write-baseline narwhal_tpu/ tests/`."""
-    baseline = Baseline.load(DEFAULT_BASELINE)
-    result = lint(REPO / "narwhal_tpu", REPO / "tests", baseline=baseline)
+    result = check_report.results["lint"]
     assert result.files_scanned > 50  # the walk found the tree
     details = "\n".join(
         f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new
@@ -60,22 +78,20 @@ def test_tree_has_no_new_findings():
     assert not result.new, f"new lint findings:\n{details}"
 
 
-def test_baseline_has_no_stale_entries():
+def test_baseline_has_no_stale_entries(check_report):
     """Grandfathered findings that get fixed must leave the baseline too,
     or the file silently re-authorizes a future regression."""
-    baseline = Baseline.load(DEFAULT_BASELINE)
-    result = lint(REPO / "narwhal_tpu", REPO / "tests", baseline=baseline)
+    result = check_report.results["lint"]
     assert not result.stale_baseline, (
         f"stale baseline entries (regenerate with --write-baseline): "
         f"{result.stale_baseline}"
     )
 
 
-def test_full_run_is_fast():
-    """The analyzer must stay cheap enough to gate every tier-1 run."""
-    t0 = time.perf_counter()
-    lint(REPO / "narwhal_tpu", REPO / "tests")
-    assert time.perf_counter() - t0 < 10.0
+def test_combined_gate_is_fast(check_report):
+    """All three planes in one process must stay cheap enough to gate
+    every tier-1 run — one pin for the whole `tools.check` invocation."""
+    assert check_report.elapsed < 25.0, check_report.timings
 
 
 # ---------------------------------------------------------------------------
@@ -403,22 +419,23 @@ def _fixture_result(fixture: str, symbol: str, rule: str):
 # -- the gate ---------------------------------------------------------------
 
 
-def test_topo_tree_has_no_new_findings():
-    """`python -m tools.analysis` must be clean modulo the (empty)
-    baseline. If this fails: fix the wiring, or justify with an inline
-    `# lint: allow(<detector>)` at the anchor site."""
-    ctx = _topo_ctx()
-    result = run_detectors(ctx, baseline=Baseline.load(TOPO_BASELINE))
+def test_topo_tree_has_no_new_findings(check_report):
+    """`python -m tools.check` (topo plane) must be clean modulo the
+    (empty) baseline. If this fails: fix the wiring, or justify with an
+    inline `# lint: allow(<detector>)` at the anchor site."""
+    result = check_report.results["topo"]
     details = "\n".join(
         f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new
     )
     assert not result.new, f"new topology findings:\n{details}"
     # The extraction actually modeled the pipeline (not a silent no-op).
-    assert len(ctx.topology.live_channels()) >= 20
-    assert len(ctx.topology.tasks) >= 30
+    assert len(check_report.topology.live_channels()) >= 20
+    assert len(check_report.topology.tasks) >= 30
     # The one justified suppression: the protocol-bounded core<->proposer
     # wait cycle (primary/core.py).
     assert any(f.rule == "bounded-channel-cycle" for f in result.suppressed)
+    # The combined runner checked artifact currency in the same pass.
+    assert not check_report.artifact_stale
 
 
 def test_topo_baseline_stays_empty():
@@ -633,13 +650,291 @@ def test_topo_cli_list_rules():
     assert topo_main(["--list-rules"]) == 0
 
 
+# (per-plane perf pins are folded into test_combined_gate_is_fast — one
+# <25s pin for the whole tools.check run; narwhal-sched keeps its own
+# acceptance pin in Part 3.)
+
+
+# ===========================================================================
+# Part 3: narwhal-sched (tools/sched) — races + replay determinism
+# ===========================================================================
+
+from tools.sched import RULES as SCHED_RULES  # noqa: E402
+from tools.sched import run_sched  # noqa: E402
+from tools.sched.__main__ import DEFAULT_BASELINE as SCHED_BASELINE  # noqa: E402
+from tools.sched.__main__ import main as sched_main  # noqa: E402
+
+SCHED_FIXTURES = REPO / "tests" / "sched_fixtures"
+
+SCHED_EXPECTED_RULES = {
+    "multi-task-mutation",
+    "await-interleaved-rmw",
+    "raw-entropy",
+    "unseeded-random",
+    "id-keyed-ordering",
+    "unordered-iteration",
+}
+
+
+def sched_scan(*paths, roots=(), baseline=None):
+    """Syntactic-only run (package='', no extraction) over fixture files;
+    pass roots to run the whole-program race rules too."""
+    return run_sched(
+        [str(p) for p in paths],
+        root=REPO,
+        package="",
+        roots=tuple(roots),
+        baseline=baseline,
+    )
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_sched_tree_has_no_new_findings(check_report):
+    """`python -m tools.check` (sched plane) must be clean modulo the
+    (empty) baseline: fix the race, or justify the deliberate idiom with
+    an inline `# lint: allow(<rule>)` at the anchor site."""
+    result = check_report.results["sched"]
+    assert result.files_scanned > 50
+    details = "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in result.new
+    )
+    assert not result.new, f"new sched findings:\n{details}"
+    # The tree's deliberate idioms (co-hosted caches, seeded global
+    # stream, register/await/cleanup) are documented inline, not silent.
+    assert len(result.suppressed) >= 20
+
+
+def test_sched_baseline_stays_empty():
+    """The sched baseline starts (and must stay) EMPTY — new findings are
+    fixed or justified inline, never grandfathered."""
+    baseline = json.loads(SCHED_BASELINE.read_text(encoding="utf-8"))
+    assert baseline["findings"] == []
+
+
+def test_sched_rule_catalog_is_complete():
+    assert set(SCHED_RULES) == SCHED_EXPECTED_RULES
+    for rule in SCHED_RULES.values():
+        assert rule.summary
+
+
+# -- PR-9 regressions: the two bugs these rules exist to re-find ------------
+
+
+def test_refinds_pr9_set_partition_bug():
+    """The connection-set iteration in set_partition (hash-order resets)
+    must trip unordered-iteration at the loop."""
+    result = sched_scan(SCHED_FIXTURES / "pr9_partition.py")
+    assert [(f.rule, f.line) for f in result.new] == [
+        ("unordered-iteration", 21)
+    ]
+    assert "hash" in result.new[0].message
+
+
+def test_refinds_pr9_urandom_nonce_bug():
+    """The os.urandom handshake nonce must trip raw-entropy at the draw."""
+    result = sched_scan(SCHED_FIXTURES / "pr9_nonce.py")
+    assert [(f.rule, f.line) for f in result.new] == [("raw-entropy", 14)]
+    assert "set_entropy" in result.new[0].message
+
+
+# -- per-rule trip/clean fixtures with pinned counts ------------------------
+
+
+def test_determinism_fixture_finding_counts():
+    """det_trip.py: one finding per shape, pinned; det_clean.py: zero."""
+    trip = sched_scan(SCHED_FIXTURES / "det_trip.py")
+    counts: dict[str, int] = {}
+    for f in trip.new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    assert counts == {
+        "raw-entropy": 1,  # uuid.uuid4
+        "unseeded-random": 3,  # module-as-RNG, global draw, Random()
+        "id-keyed-ordering": 1,
+        "unordered-iteration": 1,
+    }
+    clean = sched_scan(SCHED_FIXTURES / "det_clean.py")
+    assert not clean.new, [(f.rule, f.line) for f in clean.new]
+
+
+def test_race_fixture_finding_counts():
+    """races_trip.py (driven from its `main` wiring root): exactly one
+    multi-task-mutation (Board poked from Writer AND Reader) and one
+    await-interleaved-rmw (Counter.bump's read/await/write); the
+    disciplined twin is silent."""
+    trip = sched_scan(
+        SCHED_FIXTURES / "races_trip.py",
+        roots=("tests/sched_fixtures/races_trip.py::main",),
+    )
+    assert sorted((f.rule, f.line) for f in trip.new) == [
+        ("await-interleaved-rmw", 30),
+        ("multi-task-mutation", 39),
+    ]
+    clean = sched_scan(
+        SCHED_FIXTURES / "races_clean.py",
+        roots=("tests/sched_fixtures/races_clean.py::main",),
+    )
+    assert not clean.new, [(f.rule, f.line) for f in clean.new]
+
+
+# -- extractor attribution (the StateSite API) ------------------------------
+
+
+def test_extractor_attributes_sites_to_tasks():
+    """The race detectors are only as good as the extractor's read/write
+    attribution: one task writes, another reads, and every site must be
+    keyed to the task that performs it."""
+    topo, extractor = extract(
+        REPO, package="", roots=["tests/sched_fixtures/races_trip.py::main"]
+    )
+    by_state: dict[str, dict[str, set[str]]] = {}
+    for s in extractor.state_sites:
+        by_state.setdefault(s.state, {"read": set(), "write": set()})[
+            s.kind
+        ].add(s.task)
+    slots = by_state["Board.slots"]
+    assert slots["write"] == {"init:Board", "Writer.run", "Reader.run"}
+    assert {"Writer.run", "Reader.run"} <= slots["read"]
+    count = by_state["Counter.count"]
+    assert {"Writer.run", "Reader.run"} <= count["write"]
+    # And the race rules see exactly one runtime-shared unencapsulated
+    # state with multiple writers (the finding count pinned above).
+    result = sched_scan(
+        SCHED_FIXTURES / "races_trip.py",
+        roots=("tests/sched_fixtures/races_trip.py::main",),
+    )
+    assert sum(f.rule == "multi-task-mutation" for f in result.new) == 1
+
+
+# -- suppression ------------------------------------------------------------
+
+
+def test_sched_inline_allow(tmp_path):
+    src = tmp_path / "seam.py"
+    src.write_text(
+        "import os\n\n\n"
+        "def default_entropy(n):\n"
+        "    # the seam's own production default\n"
+        "    return os.urandom(n)  # lint: allow(raw-entropy)\n",
+        encoding="utf-8",
+    )
+    result = run_sched([str(src)], root=tmp_path, package="", roots=())
+    assert not result.new
+    assert [f.rule for f in result.suppressed] == ["raw-entropy"]
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def test_sched_cli_exit_codes_and_json():
+    bad = subprocess.run(
+        [
+            sys.executable, "-m", "tools.sched",
+            "tests/sched_fixtures/pr9_nonce.py",
+            "--format", "json", "--no-baseline",
+            "--package", "", "--roots",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    payload = json.loads(bad.stdout)
+    assert not payload["ok"]
+    assert {f["rule"] for f in payload["new"]} == {"raw-entropy"}
+    good = subprocess.run(
+        [
+            sys.executable, "-m", "tools.sched",
+            "tests/sched_fixtures/det_clean.py",
+            "--format", "json", "--no-baseline",
+            "--package", "", "--roots",
+        ],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    assert good.returncode == 0, good.stdout + good.stderr
+    assert json.loads(good.stdout)["ok"]
+
+
+def test_sched_cli_list_rules(capsys):
+    assert sched_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in SCHED_EXPECTED_RULES:
+        assert name in out
+
+
+# -- --diff mode (pre-commit: only changed files) ---------------------------
+
+
+def _git(repo: Path, *args: str) -> None:
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.email=t@t", "-c", "user.name=t",
+         *args],
+        check=True,
+        capture_output=True,
+    )
+
+
+def test_diff_mode_scans_only_changed_files(tmp_path):
+    """Synthetic two-commit repo: b.py has violated since the base rev,
+    a.py picks one up in the working tree — `--diff BASE` must report the
+    a.py finding and stay silent about unchanged b.py."""
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "a.py").write_text("X = 1\n", encoding="utf-8")
+    (tmp_path / "b.py").write_text(
+        "import os\n\nNONCE = os.urandom(8)\n", encoding="utf-8"
+    )
+    _git(tmp_path, "add", "a.py", "b.py")
+    _git(tmp_path, "commit", "-q", "-m", "base")
+    base = "HEAD"
+    (tmp_path / "a.py").write_text(
+        "import uuid\n\nTOKEN = uuid.uuid4().hex\n", encoding="utf-8"
+    )
+    result = run_sched(
+        [str(tmp_path)],
+        root=tmp_path,
+        package="",
+        roots=(),
+        diff_base=base,
+    )
+    assert [(f.path, f.rule) for f in result.new] == [("a.py", "raw-entropy")]
+    # Without --diff the unchanged violation is reported too.
+    full = run_sched([str(tmp_path)], root=tmp_path, package="", roots=())
+    assert {f.path for f in full.new} == {"a.py", "b.py"}
+
+
 # -- performance ------------------------------------------------------------
 
 
-def test_topo_full_run_is_fast():
-    """Extraction + every detector over the full tree must stay cheap
-    enough to gate every tier-1 run (<15s; ~1s in practice)."""
+def test_sched_full_run_is_fast():
+    """The acceptance pin: extraction + every detector over
+    `narwhal_tpu/ tests/` in under 15s."""
     t0 = time.perf_counter()
-    ctx = _topo_ctx()
-    run_detectors(ctx, baseline=Baseline.load(TOPO_BASELINE))
+    run_sched(
+        [str(REPO / "narwhal_tpu"), str(REPO / "tests")],
+        root=REPO,
+        baseline=Baseline.load(SCHED_BASELINE),
+    )
     assert time.perf_counter() - t0 < 15.0
+
+
+# -- the combined runner's CLI ----------------------------------------------
+
+
+def test_check_cli_combined_json():
+    """`python -m tools.check --json`: one invocation, three planes, one
+    exit code — the single command SKILL.md and pre-commit use."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.check", "--json"],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] and not payload["artifact_stale"]
+    assert set(payload) >= {"lint", "topo", "sched", "ok", "elapsed"}
+    for plane in ("lint", "topo", "sched"):
+        assert payload[plane]["ok"], plane

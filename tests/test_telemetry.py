@@ -106,6 +106,25 @@ def test_stage_timer_disabled_or_unsampled_still_observes():
         assert not [e for e in tracer.events if e[0] == "span"]
 
 
+def test_straggler_restart_cannot_mint_second_span():
+    """The certify/commit inversion, pinned at the span layer: after a
+    key's stage closes, a straggler re-start + re-stop must not emit a
+    second span. With one span per key per stage, waterfall()'s
+    earliest-t0 pick can never land on a late re-opened window, even
+    after the true span would have been evicted from the ring."""
+    registry, tracer, timer = _stage_setup(enabled=True, sample=1.0)
+    key = bytes([7]) * 32
+    timer.start(key)
+    timer.stop(key)  # the true certify window
+    timer.start(key)  # straggler vote re-delivers after the close
+    assert timer.stop(key) is None
+    spans = [e for e in tracer.events if e[0] == "span"]
+    assert len(spans) == 1
+    # And the surviving span is the FIRST window, not the straggler's.
+    _, _, _, t0, t1, _ = spans[0]
+    assert (t0, t1) == (0.25, 0.5)
+
+
 def test_sampling_is_deterministic_and_digest_keyed():
     """sampled() reads only the digest's first 4 bytes: two independent
     tracers (two nodes) always agree, so sampled runs never produce

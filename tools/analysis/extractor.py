@@ -218,6 +218,7 @@ class ModuleInfo:
     classes: dict = field(default_factory=dict)
     functions: dict = field(default_factory=dict)
     aliases: dict = field(default_factory=dict)  # local name -> full dotted
+    globals_mut: dict = field(default_factory=dict)  # mutable global -> def line
 
 
 def _module_dotted(rel: Path) -> str:
@@ -264,8 +265,47 @@ class Program:
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 info.functions[node.name] = FuncInfo(info, node, f"{dotted}.{node.name}")
         info.aliases = self._aliases(info, rel)
+        self._collect_mutable_globals(info)
         self.modules[dotted] = info
         return info
+
+    _MUTABLE_FACTORIES = frozenset({
+        "dict", "list", "set", "deque", "defaultdict", "Counter",
+        "OrderedDict", "WeakSet", "WeakKeyDictionary", "WeakValueDictionary",
+    })
+
+    def _collect_mutable_globals(self, info: ModuleInfo) -> None:
+        """Module-level names that hold shared mutable state: bindings to a
+        mutable-container literal/factory, plus any name a function rebinds
+        through a `global` declaration (an int counter rebound cross-task is
+        just as shared as a dict)."""
+        for node in info.tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            mutable = isinstance(
+                value,
+                (ast.Dict, ast.List, ast.Set, ast.ListComp, ast.SetComp,
+                 ast.DictComp),
+            )
+            if not mutable and isinstance(value, ast.Call):
+                f = value.func
+                name = (
+                    f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else ""
+                )
+                mutable = name in self._MUTABLE_FACTORIES
+            if mutable:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        info.globals_mut.setdefault(t.id, node.lineno)
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Global):
+                for n in node.names:
+                    info.globals_mut.setdefault(n, node.lineno)
 
     def _aliases(self, info: ModuleInfo, rel: Path) -> dict:
         """Local name -> absolute dotted origin, with relative imports
@@ -330,6 +370,42 @@ class Op:
     @property
     def blocking(self) -> bool:
         return self.kind in ("send", "send_many", "recv")
+
+
+@dataclass(frozen=True)
+class StateSite:
+    """One attributed access to shared mutable state.
+
+    `state` is `"<ipath>.<attr>"` for instance attributes (e.g.
+    `"Core.round"`, `"StageTimer#1._pending"`) and `"<module>:<name>"`
+    for module globals (e.g. `"narwhal_tpu.crypto:_VERIFY_CACHE"`).
+    `task` is the owning task context (`"Core.run"`, `"cb:Core.process_vote"`)
+    or `"init:<ipath>"` for construction-time accesses."""
+
+    task: str
+    state: str
+    kind: str  # read | write
+    path: str
+    line: int
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind == "write"
+
+    @property
+    def is_global(self) -> bool:
+        return ":" in self.state
+
+
+def state_table(sites: Iterable[StateSite]) -> dict:
+    """Index sites as {state: {"read": {task: [sites]}, "write": {...}}} —
+    the query shape the narwhal-sched race detectors consume."""
+    table: dict[str, dict[str, dict[str, list[StateSite]]]] = {}
+    for s in sites:
+        table.setdefault(s.state, {"read": {}, "write": {}})[s.kind].setdefault(
+            s.task, []
+        ).append(s)
+    return table
 
 
 class Topology:
@@ -402,6 +478,11 @@ class Extractor:
         self._local_stack: list = []
         self._anon_chan = 0
         self.instances: list[ObjectVal] = []
+        # Read/write-site attribution (consumed by tools/sched): every
+        # access to an instance attribute or mutable module global, keyed
+        # to the task context that performs it.
+        self.state_sites: list[StateSite] = []
+        self._state_seen: set = set()
 
     # -- public entry points -------------------------------------------
     def run_class_root(self, cls: ClassInfo) -> ObjectVal:
@@ -477,7 +558,77 @@ class Extractor:
                 env[k] = v
         for p in params:
             env.setdefault(p, UNKNOWN)
+        decls = set()
+        for n in ast.walk(func_node):
+            if isinstance(n, ast.Global):
+                decls.update(n.names)
+        if decls:
+            env["__pyglobals__"] = frozenset(decls)
         return env
+
+    # -- read/write-site attribution ------------------------------------
+    _WIRING_VALS = (
+        ChannelVal, WatchVal, ObjectVal, BoundMethodVal, BoundChannelMethod,
+        BoundCollectionMethod, CoroutineVal, LocalFuncVal,
+    )
+
+    def _is_data(self, value) -> bool:
+        """Wiring values (channels, actors, callables) are structure, not
+        shared *data*; collections, scalars and UNKNOWN are state."""
+        ms = members_of(value)
+        if not ms:
+            return True  # UNKNOWN / None: be honest, treat as data
+        structural = self._WIRING_VALS + (ClassInfo, FuncInfo)
+        return any(not isinstance(m, structural) for m in ms)
+
+    def _record_state(self, ctx, state, kind, path, line) -> None:
+        task = _task_name(ctx)
+        key = (task, state, kind, path, line)
+        if key not in self._state_seen:
+            self._state_seen.add(key)
+            self.state_sites.append(StateSite(task, state, kind, path, line))
+
+    def _note_attr_read(self, recv, attr, module, ctx, line) -> None:
+        if attr.startswith("__"):
+            return
+        for v in members_of(recv):
+            if not isinstance(v, ObjectVal) or v.cls.method(attr) is not None:
+                continue
+            cur = v.attrs.get(attr)
+            if cur is not None and not self._is_data(cur):
+                continue  # channel/actor/callable attribute: wiring
+            self._record_state(ctx, f"{v.ipath}.{attr}", "read", module.rel, line)
+
+    def _note_container_write(self, base, env, module, ctx, selfobj, depth,
+                              line) -> None:
+        """`self.pending[k] = v` / `self.events.append(x)` / `_CACHE[k] = v`
+        mutate the container held by the base attribute/global."""
+        if isinstance(base, ast.Attribute):
+            recv = self._eval(base.value, env, module, ctx, selfobj, depth)
+            for obj in members_of(recv):
+                if not isinstance(obj, ObjectVal):
+                    continue
+                if obj.cls.method(base.attr) is not None:
+                    continue
+                cur = obj.attrs.get(base.attr)
+                if cur is not None and not self._is_data(cur):
+                    continue
+                self._record_state(
+                    ctx, f"{obj.ipath}.{base.attr}", "write", module.rel, line
+                )
+        elif isinstance(base, ast.Name):
+            if base.id not in env and base.id in module.globals_mut:
+                self._record_state(
+                    ctx, f"{module.dotted}:{base.id}", "write", module.rel, line
+                )
+
+    # In-place mutator methods on containers: a call through one of these
+    # on a self-attribute or module-global receiver is a write site.
+    _MUTATORS = frozenset({
+        "append", "appendleft", "add", "update", "pop", "popleft", "popitem",
+        "setdefault", "extend", "remove", "discard", "clear", "insert",
+        "sort", "rotate",
+    })
 
     # -- statement execution -------------------------------------------
     def _exec_body(self, body, env, module, ctx, selfobj, depth) -> None:
@@ -506,6 +657,27 @@ class Extractor:
             self._assign(stmt.target, value, env, module, ctx, selfobj, depth)
         elif isinstance(stmt, ast.AugAssign):
             self._eval(stmt.value, env, module, ctx, selfobj, depth)
+            t = stmt.target
+            if isinstance(t, ast.Attribute):
+                recv = self._eval(t.value, env, module, ctx, selfobj, depth)
+                self._note_attr_read(recv, t.attr, module, ctx, t.lineno)
+                for obj in members_of(recv):
+                    if (
+                        isinstance(obj, ObjectVal)
+                        and obj.cls.method(t.attr) is None
+                    ):
+                        self._record_state(
+                            ctx, f"{obj.ipath}.{t.attr}", "write",
+                            module.rel, t.lineno,
+                        )
+            elif isinstance(t, ast.Name):
+                if (
+                    t.id in env.get("__pyglobals__", ())
+                    and t.id in module.globals_mut
+                ):
+                    state = f"{module.dotted}:{t.id}"
+                    self._record_state(ctx, state, "read", module.rel, t.lineno)
+                    self._record_state(ctx, state, "write", module.rel, t.lineno)
         elif isinstance(stmt, ast.Expr):
             self._eval(stmt.value, env, module, ctx, selfobj, depth)
         elif isinstance(stmt, ast.Return):
@@ -563,11 +735,24 @@ class Extractor:
 
     def _assign(self, target, value, env, module, ctx, selfobj, depth) -> None:
         if isinstance(target, ast.Name):
+            if (
+                target.id in env.get("__pyglobals__", ())
+                and target.id in module.globals_mut
+            ):
+                self._record_state(
+                    ctx, f"{module.dotted}:{target.id}", "write",
+                    module.rel, target.lineno,
+                )
             env[target.id] = value
         elif isinstance(target, ast.Attribute):
             recv = self._eval(target.value, env, module, ctx, selfobj, depth)
             for obj in members_of(recv):
                 if isinstance(obj, ObjectVal):
+                    if self._is_data(value):
+                        self._record_state(
+                            ctx, f"{obj.ipath}.{target.attr}", "write",
+                            module.rel, target.lineno,
+                        )
                     prev = obj.attrs.get(target.attr)
                     obj.attrs[target.attr] = (
                         value if prev is None else join(prev, value)
@@ -582,6 +767,9 @@ class Extractor:
                 self._assign(el, item, env, module, ctx, selfobj, depth)
         elif isinstance(target, ast.Subscript):
             recv = self._eval(target.value, env, module, ctx, selfobj, depth)
+            self._note_container_write(
+                target.value, env, module, ctx, selfobj, depth, target.lineno
+            )
             for c in members_of(recv):
                 if isinstance(c, CollectionVal):
                     c.items.append(value)
@@ -618,9 +806,15 @@ class Extractor:
         if isinstance(node, ast.Name):
             if node.id in env:
                 return env[node.id]
+            if node.id in module.globals_mut:
+                self._record_state(
+                    ctx, f"{module.dotted}:{node.id}", "read",
+                    module.rel, node.lineno,
+                )
             return self._module_symbol(node.id, module)
         if isinstance(node, ast.Attribute):
             recv = self._eval(node.value, env, module, ctx, selfobj, depth)
+            self._note_attr_read(recv, node.attr, module, ctx, node.lineno)
             return self._attr(recv, node.attr)
         if isinstance(node, ast.Call):
             return self._call(node, env, module, ctx, selfobj, depth, hint)
@@ -803,6 +997,13 @@ class Extractor:
             return UNKNOWN
 
         func_val = self._eval(node.func, env, module, ctx, selfobj, depth)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in self._MUTATORS
+        ):
+            self._note_container_write(
+                node.func.value, env, module, ctx, selfobj, depth, node.lineno
+            )
         args = []
         for a in node.args:
             v = self._eval(
@@ -994,6 +1195,7 @@ class Extractor:
         try:
             env = dict(closure or {})
             env.pop("__return__", None)
+            env.pop("__pyglobals__", None)
             env.update(self._bind(func_node, args, kwargs))
             self._exec_body(func_node.body, env, module, ctx, owner, depth + 1)
             rets = env.get("__return__", [])

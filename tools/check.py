@@ -1,0 +1,161 @@
+"""One command for all three static-analysis planes.
+
+`python -m tools.check` runs narwhal-lint (per-function invariants),
+narwhal-topo (whole-program actor/channel topology + stale-artifact
+check) and narwhal-sched (interleaving races + replay determinism) in a
+single process with ONE combined exit code — and one whole-program
+extraction: topo and sched share the same interpreted wiring instead of
+walking the program twice.
+
+    python -m tools.check              # the pre-commit / tier-1 gate
+    python -m tools.check --json       # machine output, per plane
+    python -m tools.check -v           # per-plane timings
+
+Exit 0 when every plane is clean (all findings suppressed or baselined,
+topology artifact current), 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+DEFAULT_PATHS = ("narwhal_tpu", "tests")
+
+
+@dataclass
+class CheckReport:
+    """Per-plane results plus the combined verdict."""
+
+    results: dict = field(default_factory=dict)  # plane -> lint.Result
+    timings: dict = field(default_factory=dict)  # plane -> seconds
+    artifact_stale: bool = False
+    elapsed: float = 0.0
+    topology: object = None  # the shared extraction (topo + sched)
+    extractor: object = None
+
+    @property
+    def ok(self) -> bool:
+        return (
+            all(r.ok for r in self.results.values())
+            and not self.artifact_stale
+        )
+
+
+def run_check(
+    root: Path = REPO_ROOT, paths: tuple = DEFAULT_PATHS
+) -> CheckReport:
+    from tools.analysis.__main__ import (
+        ARTIFACT_JSON,
+        DEFAULT_BASELINE as TOPO_BASELINE,
+        topology_doc,
+    )
+    from tools.analysis.detectors import Context, run_detectors
+    from tools.analysis.extractor import DEFAULT_PACKAGE, DEFAULT_ROOTS, extract
+    from tools.lint.__main__ import DEFAULT_BASELINE as LINT_BASELINE
+    from tools.lint.engine import Baseline, run_lint
+    from tools.sched.__main__ import DEFAULT_BASELINE as SCHED_BASELINE
+    from tools.sched.engine import run_sched
+
+    root = Path(root)
+    scan = [root / p for p in paths]
+    report = CheckReport()
+    t_all = time.perf_counter()
+
+    t0 = time.perf_counter()
+    report.results["lint"] = run_lint(
+        scan, baseline=Baseline.load(LINT_BASELINE), root=root
+    )
+    report.timings["lint"] = time.perf_counter() - t0
+
+    # ONE extraction feeds both whole-program planes.
+    t0 = time.perf_counter()
+    extraction = extract(root, package=DEFAULT_PACKAGE, roots=DEFAULT_ROOTS)
+    topo, extractor = extraction
+    report.topology, report.extractor = topo, extractor
+    ctx = Context(topo, extractor.program, root)
+    report.results["topo"] = run_detectors(
+        ctx, baseline=Baseline.load(TOPO_BASELINE)
+    )
+    doc = topology_doc(topo, DEFAULT_ROOTS)
+    try:
+        current = json.loads(ARTIFACT_JSON.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        current = None
+    report.artifact_stale = current != doc
+    report.timings["topo"] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    report.results["sched"] = run_sched(
+        scan,
+        root=root,
+        baseline=Baseline.load(SCHED_BASELINE),
+        extraction=extraction,
+    )
+    report.timings["sched"] = time.perf_counter() - t0
+
+    report.elapsed = time.perf_counter() - t_all
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    from tools.lint.report import render_json, render_text
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description=(
+            "run narwhal-lint + narwhal-topo + narwhal-sched with one "
+            "combined exit code (topo and sched share one extraction)"
+        ),
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=list(DEFAULT_PATHS),
+        help=f"scan paths for the per-file planes (default: {DEFAULT_PATHS})",
+    )
+    ap.add_argument("--root", type=Path, default=REPO_ROOT)
+    ap.add_argument(
+        "--json", action="store_true", help="machine output, one key per plane"
+    )
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    report = run_check(root=args.root, paths=tuple(args.paths))
+
+    if args.json:
+        payload = {
+            plane: json.loads(render_json(res))
+            for plane, res in report.results.items()
+        }
+        payload["artifact_stale"] = report.artifact_stale
+        payload["ok"] = report.ok
+        payload["elapsed"] = round(report.elapsed, 3)
+        print(json.dumps(payload, indent=2))
+    else:
+        for plane, res in report.results.items():
+            status = "ok" if res.ok else "FAIL"
+            line = f"[{plane}] {status}"
+            if args.verbose:
+                line += f" ({report.timings[plane]:.2f}s)"
+            print(line)
+            if not res.ok:
+                print(render_text(res, verbose=args.verbose))
+        if report.artifact_stale:
+            print(
+                "[topo] STALE ARTIFACT: tools/analysis/topology.json no "
+                "longer matches the wiring — regenerate with "
+                "`python -m tools.analysis --write-artifact`"
+            )
+        verdict = "clean" if report.ok else "FINDINGS"
+        print(f"static analysis: {verdict} in {report.elapsed:.2f}s")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

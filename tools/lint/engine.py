@@ -40,6 +40,7 @@ from typing import Iterable, Sequence
 DEFAULT_EXCLUDES: tuple[str, ...] = (
     "lint_fixtures",  # the analyzer's own tripping/clean test snippets
     "topo_fixtures",  # narwhal-topo's tripping/clean wiring fixtures
+    "sched_fixtures",  # narwhal-sched's race/determinism regression fixtures
     "__pycache__",
     "*_pb2.py",  # generated protobuf modules
     ".*",

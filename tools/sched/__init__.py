@@ -1,0 +1,19 @@
+"""narwhal-sched: the interleaving-race + replay-determinism plane.
+
+Third static-analysis gate alongside narwhal-lint (tools/lint) and
+narwhal-topo (tools/analysis). Shares lint's Finding/allow/baseline
+machinery and consumes topo's extractor for task-attributed read/write
+sites. See tools/sched/engine.py for the model and README.md for the
+detector catalog.
+"""
+
+from tools.sched.engine import (  # noqa: F401
+    RULES,
+    Detector,
+    SchedContext,
+    register,
+    run_sched,
+)
+
+# Importing the rule modules registers the detectors.
+from tools.sched import determinism, races  # noqa: F401, E402

@@ -1,0 +1,153 @@
+"""CLI: `python -m tools.sched [paths...]`.
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when new
+findings exist (or a listed path contains a syntax error), 2 on usage
+errors. Typical invocations:
+
+    python -m tools.sched narwhal_tpu/ tests/          # the tier-1 gate
+    python -m tools.sched --format json narwhal_tpu/   # machine output
+    python -m tools.sched --diff origin/main narwhal_tpu/  # pre-commit
+    python -m tools.sched --root . --package "" \\
+        --roots tests/sched_fixtures/foo.py::Node tests/sched_fixtures/foo.py
+    python -m tools.sched --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from tools.analysis.extractor import DEFAULT_PACKAGE, DEFAULT_ROOTS
+from tools.lint.engine import DEFAULT_EXCLUDES, Baseline
+from tools.lint.report import render_json, render_text
+from tools.sched.engine import RULES, run_sched
+
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sched",
+        description=(
+            "narwhal-sched: interleaving-race and replay-determinism "
+            "analysis over the task/state graph"
+        ),
+    )
+    ap.add_argument("paths", nargs="*", default=[], help="files or directories")
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    ap.add_argument(
+        "--baseline",
+        type=Path,
+        default=DEFAULT_BASELINE,
+        help=f"baseline file (default {DEFAULT_BASELINE})",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: report every finding as new",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather all current non-suppressed findings and exit 0",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="run only this rule (repeatable)",
+    )
+    ap.add_argument(
+        "--exclude",
+        action="append",
+        default=list(DEFAULT_EXCLUDES),
+        metavar="GLOB",
+        help="extra fnmatch pattern excluded from directory walks",
+    )
+    ap.add_argument(
+        "--root",
+        type=Path,
+        default=Path(__file__).resolve().parents[2],
+        help="analysis root (defaults to the repo root)",
+    )
+    ap.add_argument(
+        "--package",
+        default=DEFAULT_PACKAGE,
+        help="package interpreted for task/state attribution "
+        "('' to skip whole-program extraction)",
+    )
+    ap.add_argument(
+        "--roots",
+        nargs="*",
+        default=list(DEFAULT_ROOTS),
+        metavar="FILE.py::Symbol",
+        help="extraction roots (empty to skip extraction and run only "
+        "the syntactic determinism rules)",
+    )
+    ap.add_argument(
+        "--diff",
+        metavar="REV",
+        default=None,
+        help="analyze only files changed versus this git rev "
+        "(fast pre-commit mode; whole-program findings are filtered "
+        "to changed files)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    # Import for the registration side effect before --list-rules.
+    from tools.sched import determinism, races  # noqa: F401
+
+    if args.list_rules:
+        for name, rule in sorted(RULES.items()):
+            print(f"{name}\n    {rule.summary}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: python -m tools.sched narwhal_tpu/ tests/)")
+
+    rules = RULES
+    if args.rule:
+        unknown = set(args.rule) - set(RULES)
+        if unknown:
+            ap.error(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = {n: RULES[n] for n in args.rule}
+
+    baseline = Baseline() if args.no_baseline else Baseline.load(args.baseline)
+    t0 = time.perf_counter()
+    result = run_sched(
+        args.paths,
+        root=args.root,
+        package=args.package,
+        roots=tuple(args.roots),
+        rules=rules,
+        baseline=baseline,
+        excludes=args.exclude,
+        diff_base=args.diff,
+    )
+    elapsed = time.perf_counter() - t0
+
+    if args.write_baseline:
+        Baseline.dump(result.new + result.baselined, args.baseline)
+        print(
+            f"baseline: {len(result.new) + len(result.baselined)} finding(s) "
+            f"written to {args.baseline}"
+        )
+        return 0
+
+    if args.fmt == "json":
+        print(render_json(result))
+    else:
+        print(render_text(result, verbose=args.verbose))
+        if args.verbose:
+            print(f"({elapsed:.2f}s)")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,317 @@
+"""Replay-determinism detectors: protocol code must behave identically
+on every run of the same seeded scenario.
+
+The whole consensus argument rests on every validator deterministically
+interpreting the same DAG, and simnet's oracle testing rests on two runs
+of the same seed producing bit-identical logs. Both PR-9 divergences —
+iterating a `set` of connections in `set_partition` (hash order differs
+per process) and `os.urandom` handshake nonces — were found by hand A/B
+log diffing. These rules make the class machine-checked:
+
+* `raw-entropy` — ambient entropy (`os.urandom`, `uuid.uuid1/uuid4`,
+  `secrets.*`, `random.SystemRandom`) called in protocol code. Seeded
+  scenarios route entropy through the `auth.set_entropy` /
+  `types.set_weight_entropy` seams; drawing beside the seam diverges
+  replays. The seam *installations* (`_entropy = os.urandom`) are name
+  references, not calls, and stay quiet.
+* `unseeded-random` — the process-global `random` module used as an RNG:
+  module-level draw calls, `random.Random()` with no seed, or the module
+  object itself bound as an RNG value (`rng or random`). Under simnet the
+  global stream IS seeded (`scenario.py` pins it per plan) — sites that
+  deliberately draw from that seeded stream carry an inline allow saying
+  so. `random.seed`/`getstate`/`setstate` (the seam installers) are
+  exempt.
+* `id-keyed-ordering` — `id()` used as a key/ordering input: CPython
+  allocation addresses differ run to run, so any ordering derived from
+  them diverges replays.
+* `unordered-iteration` — a `for` loop over a `set` whose body sends,
+  signs, resets or awaits: set iteration is hash order, so effect order
+  differs between runs. `sorted(...)` the set first (the PR-9 fix).
+
+Scope: `narwhal_tpu/` plus explicitly-analyzed fixtures; the test suite
+and tooling may use ambient entropy legitimately and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.lint.engine import Finding, Module
+from tools.lint.rules import import_aliases, resolve
+from tools.sched.engine import Detector, SchedContext, protocol_scope, register
+
+
+class _SyntacticDetector(Detector):
+    """Shared per-module iteration for the determinism family."""
+
+    def check(self, ctx: SchedContext) -> Iterator[Finding]:
+        for mod in ctx.modules:
+            if not protocol_scope(mod.rel):
+                continue
+            yield from self.check_module(mod)
+
+    def check_module(self, mod: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+_ENTROPY_CALLS = frozenset({
+    "os.urandom",
+    "uuid.uuid1",
+    "uuid.uuid4",
+    "random.SystemRandom",
+})
+
+
+@register
+class RawEntropy(_SyntacticDetector):
+    name = "raw-entropy"
+    summary = (
+        "ambient entropy (os.urandom/uuid/secrets) outside the "
+        "auth.set_entropy seam — diverges seeded replays"
+    )
+
+    def check_module(self, mod: Module) -> Iterator[Finding]:
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve(node.func, aliases)
+            if target is None:
+                continue
+            if target in _ENTROPY_CALLS or target.startswith("secrets."):
+                yield mod.finding(
+                    self.name,
+                    node,
+                    f"`{target}` draws ambient entropy; protocol code must "
+                    "draw through the seeded entropy seam "
+                    "(auth.set_entropy / types.set_weight_entropy) so "
+                    "replays of the same scenario seed are bit-identical",
+                )
+
+
+_GLOBAL_DRAWS = frozenset({
+    "random", "uniform", "randint", "randrange", "choice", "choices",
+    "shuffle", "sample", "gauss", "betavariate", "expovariate",
+    "normalvariate", "triangular", "randbytes", "getrandbits",
+})
+
+
+@register
+class UnseededRandom(_SyntacticDetector):
+    name = "unseeded-random"
+    summary = (
+        "the process-global random module used as an RNG (unseeded "
+        "outside simnet); inject a seeded random.Random instead"
+    )
+
+    def check_module(self, mod: Module) -> Iterator[Finding]:
+        aliases = import_aliases(mod.tree)
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                target = resolve(node.func, aliases)
+                if target == "random.Random" and not node.args:
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        "`random.Random()` with no seed draws from OS "
+                        "entropy at construction; pass an explicit seed "
+                        "or the scenario's rng",
+                    )
+                elif (
+                    target is not None
+                    and target.startswith("random.")
+                    and target.split(".", 1)[1] in _GLOBAL_DRAWS
+                ):
+                    yield mod.finding(
+                        self.name,
+                        node,
+                        f"`{target}` draws from the process-global random "
+                        "stream; outside a seeded simnet scenario this is "
+                        "unseeded — deliberate draws from the "
+                        "scenario-seeded global stream carry an inline "
+                        "allow saying so",
+                    )
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if resolve(node, aliases) != "random":
+                    continue
+                parent = parents.get(node)
+                # Qualified uses (`random.x`) are the call rules' business;
+                # what this arm catches is the module OBJECT bound as an
+                # RNG value: `self._rng = rng or random`.
+                if isinstance(parent, ast.Attribute):
+                    continue
+                yield mod.finding(
+                    self.name,
+                    node,
+                    "the `random` module object is bound as an RNG value; "
+                    "its draws are process-global and unseeded outside "
+                    "simnet — inject a seeded random.Random",
+                )
+
+
+@register
+class IdKeyedOrdering(_SyntacticDetector):
+    name = "id-keyed-ordering"
+    summary = (
+        "id() used as a key or ordering input — allocation addresses "
+        "differ run to run"
+    )
+
+    def check_module(self, mod: Module) -> Iterator[Finding]:
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and resolve(node.func, aliases) == "id"
+                and len(node.args) == 1
+            ):
+                yield mod.finding(
+                    self.name,
+                    node,
+                    "`id()` yields a CPython allocation address: any "
+                    "ordering, dict key or dedup derived from it differs "
+                    "between runs — key on a stable protocol identity "
+                    "(digest, name, connection id) instead",
+                )
+
+
+# Calls whose invocation order is an observable effect: wire sends,
+# signatures, connection-state transitions, task scheduling.
+_EFFECT_CALLS = frozenset({
+    "send", "send_many", "try_send", "unreliable_send", "request",
+    "write", "writelines", "reset", "sign", "ensure_future",
+    "create_task", "call_soon", "call_later", "call_at", "put",
+    "put_nowait", "set_result", "set_exception", "feed_data", "feed_eof",
+    "broadcast", "spawn",
+})
+
+
+class _SetCollector(ast.NodeVisitor):
+    """Names/attributes syntactically bound to set values."""
+
+    def __init__(self, aliases: dict):
+        self.aliases = aliases
+        self.local_sets: set[str] = set()  # bare names
+        self.attr_sets: set[str] = set()  # `self.X` within a class
+
+    def _is_set_expr(self, node) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            return resolve(node.func, self.aliases) in ("set", "frozenset")
+        return False
+
+    def _is_set_annotation(self, node) -> bool:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value.split("[")[0].strip() in ("set", "frozenset")
+        return resolve(node, self.aliases) in ("set", "frozenset") or (
+            isinstance(node, ast.Attribute) and node.attr in ("Set", "FrozenSet")
+        )
+
+    def visit_Assign(self, node):
+        if self._is_set_expr(node.value):
+            for t in node.targets:
+                self._bind(t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if (node.value is not None and self._is_set_expr(node.value)) or (
+            self._is_set_annotation(node.annotation)
+        ):
+            self._bind(node.target)
+        self.generic_visit(node)
+
+    def _bind(self, target):
+        if isinstance(target, ast.Name):
+            self.local_sets.add(target.id)
+        elif isinstance(target, ast.Attribute) and isinstance(
+            target.value, ast.Name
+        ):
+            self.attr_sets.add(target.attr)
+
+
+@register
+class UnorderedIteration(_SyntacticDetector):
+    name = "unordered-iteration"
+    summary = (
+        "effectful iteration over a set — hash order reorders sends/"
+        "signatures/scheduling between runs; sort it first"
+    )
+
+    def check_module(self, mod: Module) -> Iterator[Finding]:
+        aliases = import_aliases(mod.tree)
+        # One collector per lexical region: module level, plus each class
+        # (self-attr sets are class-scoped).
+        module_sets = _SetCollector(aliases)
+        module_sets.visit(mod.tree)
+        class_ranges: list[tuple[int, int, _SetCollector]] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                c = _SetCollector(aliases)
+                c.visit(node)
+                class_ranges.append(
+                    (node.lineno, node.end_lineno or node.lineno, c)
+                )
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            if not self._iterates_set(
+                node.iter, aliases, module_sets, class_ranges, node.lineno
+            ):
+                continue
+            if not self._body_effectful(node.body):
+                continue
+            yield mod.finding(
+                self.name,
+                node,
+                "iterating a set whose body has observable effects "
+                "(sends/signatures/scheduling): set iteration is hash "
+                "order and differs between runs — iterate "
+                "`sorted(...)` over a stable key instead",
+            )
+
+    def _iterates_set(self, it, aliases, module_sets, class_ranges, line):
+        # `list(X)`/`tuple(X)` materialize but keep the unordered order.
+        if isinstance(it, ast.Call) and resolve(it.func, aliases) in (
+            "list", "tuple",
+        ) and len(it.args) == 1:
+            it = it.args[0]
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(it, ast.Call):
+            return resolve(it.func, aliases) in ("set", "frozenset")
+        if isinstance(it, ast.Name):
+            if it.id in module_sets.local_sets:
+                return True
+            return any(
+                lo <= line <= hi and it.id in c.local_sets
+                for lo, hi, c in class_ranges
+            )
+        if isinstance(it, ast.Attribute) and isinstance(it.value, ast.Name):
+            for lo, hi, c in class_ranges:
+                if lo <= line <= hi and it.attr in c.attr_sets:
+                    return True
+        return False
+
+    def _body_effectful(self, body) -> bool:
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Await):
+                    return True
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    name = (
+                        f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else ""
+                    )
+                    if name in _EFFECT_CALLS:
+                        return True
+        return False

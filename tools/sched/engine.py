@@ -1,0 +1,263 @@
+"""narwhal-sched engine: shared-state attribution + scheduling-determinism
+scanning over the whole program.
+
+The third analysis plane. narwhal-lint gates per-function invariants and
+narwhal-topo gates the actor/channel wiring; what neither sees are the two
+bug classes that cost this repo the most wall-clock to diagnose:
+
+* **interleaving races** — asyncio tasks sharing mutable state across
+  `await` yield points (the certify/commit span race chased across PRs
+  13/14/16, the PR-1 epoch-change deadlock). The race detectors consume
+  the topology extractor's read/write-site attribution
+  (`tools/analysis/extractor.py::StateSite`): every access to an instance
+  attribute or mutable module global, keyed to the task that performs it.
+
+* **replay nondeterminism** — protocol code whose behavior differs
+  between two runs of the same seeded scenario (the PR-9 set-iteration
+  and os.urandom divergences, found by hand A/B log diffing). These
+  detectors are syntactic, per-module, and scoped to protocol code
+  (`narwhal_tpu/` and explicitly-analyzed fixtures — not tests, which
+  may legitimately use ambient entropy).
+
+Machinery (Finding identity, `# lint: allow(...)` suppressions, baseline
+multiset, reporters) is shared verbatim with narwhal-lint: a sched rule
+is allowed the same way a lint rule is, and the checked-in baseline is
+empty by policy — the tree stays clean, deliberate idioms carry inline
+allows at the finding's anchor line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator, Sequence
+
+from tools.analysis.extractor import (
+    DEFAULT_PACKAGE,
+    DEFAULT_ROOTS,
+    Extractor,
+    StateSite,
+    Topology,
+    extract,
+    state_table,
+)
+from tools.lint.engine import (
+    DEFAULT_EXCLUDES,
+    Baseline,
+    Finding,
+    Module,
+    Result,
+    discover,
+    parse_module,
+)
+
+__all__ = [
+    "RULES",
+    "SchedContext",
+    "Detector",
+    "register",
+    "run_sched",
+    "changed_files",
+]
+
+
+@dataclass
+class SchedContext:
+    """Everything a detector may consult for one run."""
+
+    root: Path
+    modules: list[Module]  # the syntactic scan set, allows pre-scanned
+    extractor: Extractor | None = None
+    topology: Topology | None = None
+    diff_files: set[str] | None = None  # repo-relative; None = unrestricted
+
+    _by_rel: dict = field(default_factory=dict)
+    _containers: dict = field(default_factory=dict)  # rel -> [(lo, hi, name)]
+
+    def __post_init__(self):
+        self._by_rel = {m.rel: m for m in self.modules}
+        if self.extractor is not None:
+            for mod in self.extractor.program.modules.values():
+                spans = [
+                    (ci.node.lineno, ci.node.end_lineno or ci.node.lineno, name)
+                    for name, ci in mod.classes.items()
+                ]
+                spans.sort(key=lambda s: (s[0], -s[1]))
+                self._containers[mod.rel] = spans
+
+    # -- source access --------------------------------------------------
+    def module(self, rel: str) -> Module | None:
+        """Scan-set module for `rel`, parsing on demand when a finding
+        anchors outside the scan set (whole-program detectors can)."""
+        mod = self._by_rel.get(rel)
+        if mod is None:
+            path = self.root / rel
+            if path.is_file():
+                parsed = parse_module(path, self.root)
+                if isinstance(parsed, Module):
+                    mod = parsed
+            self._by_rel[rel] = mod
+        return mod
+
+    def snippet(self, rel: str, line: int) -> str:
+        mod = self.module(rel)
+        return mod.snippet(line) if mod is not None else ""
+
+    def finding(self, rule: str, rel: str, line: int, message: str) -> Finding:
+        return Finding(rule, rel, line, 0, message, self.snippet(rel, line))
+
+    def allowed(self, f: Finding) -> bool:
+        mod = self.module(f.path)
+        return mod is not None and mod.allowed(f)
+
+    # -- structural queries ---------------------------------------------
+    def container_of(self, rel: str, line: int) -> str:
+        """Innermost class whose body contains (rel, line), else the
+        module itself — the encapsulation unit owning that code."""
+        best = None
+        for lo, hi, name in self._containers.get(rel, ()):
+            if lo <= line <= hi and (best is None or lo > best[0]):
+                best = (lo, name)
+        return best[1] if best is not None else f"module:{rel}"
+
+    def shared_states(self, min_tasks: int = 2) -> dict[str, dict]:
+        """State-table entries accessed by >= `min_tasks` distinct
+        non-init tasks, with `#n` instance suffixes normalized away so
+        every instance of a class aggregates into one logical state."""
+        if self.extractor is None:
+            return {}
+        merged: dict[str, dict[str, dict[str, list[StateSite]]]] = {}
+        for state, kinds in state_table(self.extractor.state_sites).items():
+            norm = re.sub(r"#\d+", "", state)
+            slot = merged.setdefault(norm, {"read": {}, "write": {}})
+            for kind, tasks in kinds.items():
+                for task, sites in tasks.items():
+                    slot[kind].setdefault(task, []).extend(sites)
+        out = {}
+        for state, kinds in merged.items():
+            tasks = {
+                t
+                for k in kinds.values()
+                for t in k
+                if not t.startswith("init:")
+            }
+            if len(tasks) >= min_tasks:
+                out[state] = kinds
+        return out
+
+
+class Detector:
+    """One sched rule; subclasses set name/summary and yield Findings."""
+
+    name = "base"
+    summary = ""
+
+    def check(self, ctx: SchedContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+RULES: dict[str, Detector] = {}
+
+
+def register(cls):
+    RULES[cls.name] = cls()
+    return cls
+
+
+def protocol_scope(rel: str) -> bool:
+    """Determinism rules apply to protocol/simnet-reachable code: the
+    package, explicitly-analyzed sched fixtures, and out-of-repo trees
+    (the --diff unit tests run against synthetic repos) — but not the
+    test suite or tooling, which may use ambient entropy legitimately."""
+    parts = Path(rel).parts
+    if "sched_fixtures" in parts:
+        return True
+    return "tests" not in parts and "tools" not in parts
+
+
+def changed_files(root: Path, base: str) -> set[str]:
+    """Repo-relative .py paths changed between `base` and the working
+    tree (deleted files excluded — nothing to analyze)."""
+    proc = subprocess.run(
+        [
+            "git", "-C", str(root), "diff", "--name-only",
+            "--diff-filter=d", base, "--", "*.py",
+        ],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    return {line.strip() for line in proc.stdout.splitlines() if line.strip()}
+
+
+def run_sched(
+    paths: Iterable[str | Path],
+    *,
+    root: Path,
+    package: str = DEFAULT_PACKAGE,
+    roots: Sequence[str] = DEFAULT_ROOTS,
+    rules: dict | None = None,
+    baseline: Baseline | None = None,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+    diff_base: str | None = None,
+    extraction: tuple[Topology, Extractor] | None = None,
+) -> Result:
+    """Run every registered detector; same Result contract as run_lint.
+
+    `extraction` lets an embedder (tools.check) share one whole-program
+    extraction between topo and sched instead of interpreting twice.
+    `diff_base` restricts the syntactic scan AND the reported findings to
+    files changed since that rev — whole-program extraction still sees
+    the full package (races are whole-program properties)."""
+    # Import for the registration side effect; rules live in RULES.
+    from tools.sched import determinism, races  # noqa: F401
+
+    rules = RULES if rules is None else rules
+    baseline = baseline or Baseline()
+    root = Path(root)
+
+    diff_files: set[str] | None = None
+    if diff_base is not None:
+        diff_files = changed_files(root, diff_base)
+
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    suppressed: list[Finding] = []
+    modules: list[Module] = []
+    files = discover(paths, excludes)
+    for path in files:
+        mod = parse_module(path, root)
+        if isinstance(mod, Finding):
+            if diff_files is None or mod.path in diff_files:
+                new.append(mod)
+            continue
+        if diff_files is not None and mod.rel not in diff_files:
+            continue
+        modules.append(mod)
+
+    if extraction is None and roots:
+        extraction = extract(root, package=package, roots=roots)
+    topology, extractor = extraction if extraction is not None else (None, None)
+
+    ctx = SchedContext(
+        root=root,
+        modules=modules,
+        extractor=extractor,
+        topology=topology,
+        diff_files=diff_files,
+    )
+    for rule in rules.values():
+        for f in rule.check(ctx):
+            if diff_files is not None and f.path not in diff_files:
+                continue
+            if ctx.allowed(f):
+                suppressed.append(f)
+            elif baseline.claim(f):
+                baselined.append(f)
+            else:
+                new.append(f)
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Result(new, baselined, suppressed, baseline.stale(), len(files))

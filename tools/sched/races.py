@@ -1,0 +1,302 @@
+"""Interleaving-race detectors: shared mutable state across asyncio tasks.
+
+Both rules consume the extractor's read/write-site attribution. The model
+of danger is cooperative scheduling: code between two awaits is atomic, so
+multi-task access to an instance attribute is safe *while it stays behind
+one encapsulation boundary whose methods don't yield mid-mutation*. What
+breaks is (a) state mutated from multiple tasks with no single owning
+discipline — a process-wide module global, or an attribute poked from
+outside its class — and (b) a read-modify-write of shared state that spans
+an `await` inside one function (check-then-act across a yield point: the
+value checked is stale by the time the write lands).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.lint.engine import Finding
+from tools.sched.engine import Detector, SchedContext, register
+
+
+def _writer_tasks(kinds: dict) -> set[str]:
+    return {t for t in kinds["write"] if not t.startswith("init:")}
+
+
+def _runtime_sites(kinds: dict) -> list:
+    return [
+        s
+        for k in kinds.values()
+        for sites in k.values()
+        for s in sites
+        if not s.task.startswith("init:")
+    ]
+
+
+def _fmt_tasks(tasks: set[str], cap: int = 4) -> str:
+    ordered = sorted(tasks)
+    shown = ", ".join(ordered[:cap])
+    if len(ordered) > cap:
+        shown += f", +{len(ordered) - cap} more"
+    return shown
+
+
+@register
+class MultiTaskMutation(Detector):
+    name = "multi-task-mutation"
+    summary = (
+        "shared mutable state written by multiple tasks with no "
+        "single-writer discipline (process-wide global, or instance "
+        "state accessed from outside its owning class)"
+    )
+
+    def check(self, ctx: SchedContext) -> Iterator[Finding]:
+        for state, kinds in sorted(ctx.shared_states().items()):
+            writers = _writer_tasks(kinds)
+            if not writers:
+                continue
+            sites = _runtime_sites(kinds)
+            if ":" in state:
+                # Module global: process-wide, shared across every
+                # co-hosted simnet node regardless of yield discipline.
+                if len(writers) < 2:
+                    continue
+                anchor = min(
+                    (s for s in sites if s.is_write),
+                    key=lambda s: (s.path, s.line),
+                )
+                yield ctx.finding(
+                    self.name,
+                    anchor.path,
+                    anchor.line,
+                    f"module global `{state}` is written by "
+                    f"{len(writers)} tasks ({_fmt_tasks(writers)}); "
+                    "process-wide state crosses co-hosted node boundaries "
+                    "— deliberately-shared caches need a documented "
+                    "`# lint: allow(multi-task-mutation)` at this site",
+                )
+            else:
+                # Instance attribute: flag only unencapsulated sharing —
+                # access sites spanning more than one class body. State
+                # touched solely through its owner's methods keeps a
+                # single mutation discipline (and rule
+                # await-interleaved-rmw covers yields inside it).
+                owner = state.split(".")[0]
+                containers = {
+                    ctx.container_of(s.path, s.line) for s in sites
+                }
+                if len(containers) < 2:
+                    continue
+                foreign = sorted(
+                    (s for s in sites if ctx.container_of(s.path, s.line) != owner),
+                    key=lambda s: (s.path, s.line),
+                )
+                anchor = next(
+                    (s for s in foreign if s.is_write), foreign[0]
+                )
+                tasks = {s.task for s in sites}
+                yield ctx.finding(
+                    self.name,
+                    anchor.path,
+                    anchor.line,
+                    f"`{state}` is accessed by {len(tasks)} tasks "
+                    f"({_fmt_tasks(tasks)}) across class boundaries "
+                    f"({', '.join(sorted(containers))}) with writes from "
+                    f"{_fmt_tasks(writers)}; shared mutable state needs a "
+                    "single owning writer or a documented discipline",
+                )
+
+
+class _AttrAccessScan(ast.NodeVisitor):
+    """Linear scan of one function body: ordered (line, event) stream of
+    awaits plus reads/writes of `self.<attr>` and of given global names.
+    Does not descend into nested function definitions — they run on
+    their own schedule."""
+
+    _MUTATORS = frozenset({
+        "append", "appendleft", "add", "update", "pop", "popleft",
+        "popitem", "setdefault", "extend", "remove", "discard", "clear",
+        "insert", "sort", "rotate",
+    })
+
+    def __init__(self, self_name: str, globals_of_interest: set[str]):
+        self.self_name = self_name
+        self.globals_of_interest = globals_of_interest
+        self.awaits: list[int] = []
+        self.reads: dict[str, list[int]] = {}
+        self.writes: dict[str, list[int]] = {}
+        self._local_names: set[str] = set()
+        self._global_decls: set[str] = set()
+
+    # -- structure ------------------------------------------------------
+    def visit_FunctionDef(self, node):  # do not descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Global(self, node):
+        self._global_decls.update(node.names)
+
+    def visit_Await(self, node):
+        self.awaits.append(node.lineno)
+        self.generic_visit(node)
+
+    # -- self.<attr> ----------------------------------------------------
+    def _is_self_attr(self, node) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self.self_name
+        )
+
+    def visit_Attribute(self, node):
+        if self._is_self_attr(node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.writes.setdefault(node.attr, []).append(node.lineno)
+            else:
+                self.reads.setdefault(node.attr, []).append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self._is_self_attr(node.target):
+            self.reads.setdefault(node.target.attr, []).append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        # `self.pending.pop(k)` / `_CACHE.setdefault(...)`: container write.
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in self._MUTATORS:
+            if self._is_self_attr(f.value):
+                self.writes.setdefault(f.value.attr, []).append(node.lineno)
+            elif (
+                isinstance(f.value, ast.Name)
+                and f.value.id in self.globals_of_interest
+            ):
+                self.writes.setdefault(
+                    f"::{f.value.id}", []
+                ).append(node.lineno)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # `self.pending[k] = v` / `_CACHE[k] = v`
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            if self._is_self_attr(node.value):
+                self.writes.setdefault(node.value.attr, []).append(node.lineno)
+            elif (
+                isinstance(node.value, ast.Name)
+                and node.value.id in self.globals_of_interest
+            ):
+                self.writes.setdefault(
+                    f"::{node.value.id}", []
+                ).append(node.lineno)
+        self.generic_visit(node)
+
+    # -- module globals -------------------------------------------------
+    def visit_Name(self, node):
+        if node.id in self.globals_of_interest:
+            if isinstance(node.ctx, ast.Load):
+                if node.id not in self._local_names:
+                    self.reads.setdefault(f"::{node.id}", []).append(node.lineno)
+            elif node.id in self._global_decls:
+                self.writes.setdefault(f"::{node.id}", []).append(node.lineno)
+            else:
+                self._local_names.add(node.id)  # local shadow, not the global
+        self.generic_visit(node)
+
+
+def _rmw_spans_await(scan: _AttrAccessScan, key: str) -> int | None:
+    """Line of the first write that lands after an await which itself
+    follows a read — the check-then-act shape — else None."""
+    reads = scan.reads.get(key, ())
+    writes = scan.writes.get(key, ())
+    for a in scan.awaits:
+        if any(r < a for r in reads):
+            later = [w for w in writes if w > a]
+            if later:
+                return min(later)
+    return None
+
+
+@register
+class AwaitInterleavedRMW(Detector):
+    name = "await-interleaved-rmw"
+    summary = (
+        "read-modify-write of task-shared state spanning an await inside "
+        "one function (check-then-act across a yield point)"
+    )
+
+    def check(self, ctx: SchedContext) -> Iterator[Finding]:
+        if ctx.extractor is None:
+            return
+        shared = ctx.shared_states()
+        # Only states with >=2 *writer* tasks can lose an update: a lone
+        # writer's RMW over an await is stale-read-tolerant by design.
+        attrs_by_class: dict[str, set[str]] = {}
+        globals_by_module: dict[str, set[str]] = {}
+        for state, kinds in shared.items():
+            if len(_writer_tasks(kinds)) < 2:
+                continue
+            if ":" in state:
+                mod, name = state.split(":", 1)
+                globals_by_module.setdefault(mod, set()).add(name)
+            else:
+                owner, _, attr = state.partition(".")
+                attrs_by_class.setdefault(owner, set()).add(attr)
+
+        program = ctx.extractor.program
+        seen: set[tuple[str, int, str]] = set()
+        for mod in sorted(program.modules.values(), key=lambda m: m.rel):
+            globals_here = globals_by_module.get(mod.dotted, set())
+            for cls_name, ci in sorted(mod.classes.items()):
+                attrs = attrs_by_class.get(cls_name, set())
+                if not attrs and not globals_here:
+                    continue
+                for mname, fn in sorted(ci.methods.items()):
+                    if not isinstance(fn, ast.AsyncFunctionDef):
+                        continue
+                    yield from self._scan_function(
+                        ctx, mod, f"{cls_name}.{mname}", fn, attrs,
+                        globals_here, seen,
+                    )
+            for fname, fi in sorted(mod.functions.items()):
+                if globals_here and isinstance(fi.node, ast.AsyncFunctionDef):
+                    yield from self._scan_function(
+                        ctx, mod, fname, fi.node, set(), globals_here, seen
+                    )
+
+    def _scan_function(
+        self, ctx, mod, qual, fn, attrs, globals_here, seen
+    ) -> Iterator[Finding]:
+        args = fn.args.args
+        self_name = args[0].arg if args else "self"
+        scan = _AttrAccessScan(self_name, globals_here)
+        for stmt in fn.body:
+            scan.visit(stmt)
+        if not scan.awaits:
+            return
+        for attr in sorted(attrs):
+            line = _rmw_spans_await(scan, attr)
+            if line is not None and (mod.rel, line, attr) not in seen:
+                seen.add((mod.rel, line, attr))
+                yield ctx.finding(
+                    self.name,
+                    mod.rel,
+                    line,
+                    f"`self.{attr}` is read before an await and written "
+                    f"after it in `{qual}`; another task can mutate it at "
+                    "the yield point, making this a stale check-then-act",
+                )
+        for g in sorted(globals_here):
+            line = _rmw_spans_await(scan, f"::{g}")
+            if line is not None and (mod.rel, line, g) not in seen:
+                seen.add((mod.rel, line, g))
+                yield ctx.finding(
+                    self.name,
+                    mod.rel,
+                    line,
+                    f"module global `{g}` is read before an await and "
+                    f"written after it in `{qual}`; concurrent tasks "
+                    "interleave at the yield point",
+                )
