@@ -119,13 +119,18 @@ def run_leg(
         except OSError:
             pass
     probe_after = calibrate.calibration_probe()
-    return {
+    leg = {
         "side": side,
         "value": extract_metric(doc, metric, select),
         "wall_s": round(wall_s, 2),
         "calibration_before": probe_before,
         "calibration_after": probe_after,
     }
+    # Socket-wall accounting rides along when the leg's bench records it
+    # (liveness does), so pooled-vs-mesh fd pressure lands in the ledger.
+    if isinstance(doc, dict) and doc.get("peak_fds_per_node") is not None:
+        leg["peak_fds_per_node"] = doc["peak_fds_per_node"]
+    return leg
 
 
 def same_side_band(values: list[float]) -> float:

@@ -34,32 +34,88 @@ import sys
 import time
 
 
-def estimate_required_fds(nodes: int, workers: int) -> int:
+def pooling_enabled() -> bool:
+    """Mirror config.connection_pool_effective's NARWHAL_POOL kill-switch
+    without importing narwhal_tpu (the preflight must stay import-light)."""
+    return os.environ.get("NARWHAL_POOL", "1").strip().lower() not in (
+        "0", "false", "off",
+    )
+
+
+def estimate_required_fds(nodes: int, workers: int, pooled: bool = True) -> int:
     """Upper-bound fd demand of an N-node, W-worker in-process committee
     over real sockets. Every in-process TCP connection burns TWO fds (both
-    endpoints live here). Meshes: primary vote mesh N·(N-1) connections,
-    one same-id worker mesh per lane N·(N-1)·W, primary<->own-worker
-    control 2·N·W; plus listeners (primary, typed api, grpc api = 3 per
+    endpoints live here).
+
+    Pooled (connection_pool=True, the default): ONE multiplexed link per
+    unordered node pair carries every lane — primary votes and all W
+    worker meshes — so connections = N·(N-1)/2 pair links + N self links
+    (primary<->own-worker control rides a node's link to itself). Crossed
+    dials transiently double a pair's sockets until the loser
+    linger-closes, so the socket term gets 25% boot-burst headroom.
+
+    Legacy (NARWHAL_POOL=0): primary vote mesh N·(N-1) connections, one
+    same-id worker mesh per lane N·(N-1)·W, primary<->own-worker control
+    2·N·W. Either way add listeners (primary, typed api, grpc api = 3 per
     node; worker mesh + tx + grpc tx = 3 per worker) and a flat allowance
     for stores/logs/jax."""
-    connections = nodes * (nodes - 1) * (1 + workers) + 2 * nodes * workers
     listeners = nodes * (3 + 3 * workers)
+    if pooled:
+        connections = nodes * (nodes - 1) // 2 + nodes
+        return int(2 * connections * 1.25) + listeners + 256
+    connections = nodes * (nodes - 1) * (1 + workers) + 2 * nodes * workers
     return 2 * connections + listeners + 256
 
 
-def preflight_fd_check(nodes: int, workers: int) -> None:
+def preflight_fd_check(
+    nodes: int, workers: int, pooled: bool | None = None
+) -> None:
     """Fail fast (and actionably) instead of mid-run EMFILE — the
-    n100_liveness.json failure mode."""
+    r9 n100_liveness.json failure mode."""
+    if pooled is None:
+        pooled = pooling_enabled()
     soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
-    needed = estimate_required_fds(nodes, workers)
+    needed = estimate_required_fds(nodes, workers, pooled)
     if needed > soft:
+        model = (
+            "≈N·(N-1)/2+N pooled pair links ×2 fds, + headroom + listeners"
+            if pooled
+            else "≈2·N·(N-1)·(1+W) legacy mesh sockets + listeners"
+        )
         raise SystemExit(
             f"liveness preflight: N={nodes} W={workers} needs ~{needed:,} "
-            f"fds (≈2·N·(N-1)·(1+W) mesh sockets + listeners) but "
+            f"fds ({model}) but "
             f"RLIMIT_NOFILE is {soft:,}. Raise `ulimit -n`, shrink the "
             "committee, or run this committee socket-free with --simnet "
             "(virtual-clock in-memory transport; no fd cost, N=200+ fits)."
         )
+
+
+def process_fd_count() -> int:
+    """Open fds in THIS process right now (the whole committee lives here,
+    so this is the number the rlimit actually judges)."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # non-procfs platform
+        return -1
+
+
+def _pool_link_peaks(cluster) -> list[int]:
+    """Per-node peak live pooled-link counts, one entry per booted node.
+
+    ``cluster.authorities[i].primary`` is the PrimaryNode assembly; the
+    Primary role that owns the LanePool sits one level in at ``.primary``.
+    """
+    peaks = []
+    for a in cluster.authorities:
+        node = a.primary
+        if node is None:
+            continue
+        role = getattr(node, "primary", node)
+        pool = getattr(role, "pool", None)
+        if pool is not None:
+            peaks.append(pool.peak_links)
+    return peaks
 
 
 async def run_liveness(args) -> dict:
@@ -78,10 +134,13 @@ async def run_liveness(args) -> dict:
             verify_rule=args.verify_rule,
         ),
     )
+    fd_baseline = process_fd_count()
     t0 = time.time()
     await cluster.start(args.nodes - args.faults)
     boot_s = time.time() - t0
-    print(f"booted {args.nodes - args.faults} nodes in {boot_s:.0f}s", file=sys.stderr)
+    peak_fds = process_fd_count()
+    print(f"booted {args.nodes - args.faults} nodes in {boot_s:.0f}s "
+          f"({peak_fds} fds open)", file=sys.stderr)
 
     def committed() -> list[float]:
         return [
@@ -110,6 +169,7 @@ async def run_liveness(args) -> dict:
     try:
         while time.time() - t_start < args.duration:
             await asyncio.sleep(args.sample_interval)
+            peak_fds = max(peak_fds, process_fd_count())
             rounds = committed()
             samples.append(
                 {
@@ -121,6 +181,8 @@ async def run_liveness(args) -> dict:
             print(f"  t={samples[-1]['t_s']}s committed "
                   f"[{min(rounds)}, {max(rounds)}]", file=sys.stderr)
     finally:
+        peak_fds = max(peak_fds, process_fd_count())
+        link_peaks = _pool_link_peaks(cluster)
         wire1 = WireStats.snapshot()
         egress1 = primary_sent_by_type()
         rounds1 = committed()
@@ -128,12 +190,23 @@ async def run_liveness(args) -> dict:
         await cluster.shutdown()
 
     window = time.time() - t_start
+    alive = args.nodes - args.faults
     record = _record(
         args, "in-process liveness", boot_s, samples, window,
         rounds0, rounds1, wire0, wire1, egress0, egress1,
-        alive=args.nodes - args.faults,
+        alive=alive,
     )
     record["telemetry_scrape"] = telemetry
+    # Socket-wall accounting: the committee shares one process, so the
+    # process-wide peak divided by booted nodes is the per-node fd story
+    # (pooled target: O(N); legacy mesh: O(N·W)).
+    record["fd_baseline"] = fd_baseline
+    record["peak_process_fds"] = peak_fds
+    record["peak_fds_per_node"] = (
+        round((peak_fds - fd_baseline) / alive, 1) if peak_fds >= 0 else None
+    )
+    record["peak_pool_links_per_node"] = max(link_peaks, default=None)
+    record["connection_pool"] = bool(link_peaks)
     return record
 
 
@@ -230,6 +303,7 @@ def run_liveness_simnet(args) -> dict:
                 file=sys.stderr,
             )
         window = loop.time() - v_start
+        link_peaks = _pool_link_peaks(cluster)
         wire1 = WireStats.snapshot()
         egress1 = primary_sent_by_type()
         rounds1 = committed()
@@ -247,6 +321,14 @@ def run_liveness_simnet(args) -> dict:
         record["fabric_events"] = len(fabric.log)
         record["transport_auth"] = not args.no_auth
         record["seed"] = args.seed
+        # Virtual analogue of the fd story: peak simultaneous fabric
+        # connections, committee-wide and per booted node.
+        alive = args.nodes - args.faults
+        peak_conns = fabric.counters["peak_conns"]
+        record["peak_fabric_conns"] = peak_conns
+        record["peak_fds_per_node"] = round(2 * peak_conns / alive, 1)
+        record["peak_pool_links_per_node"] = max(link_peaks, default=None)
+        record["connection_pool"] = bool(link_peaks)
         return record
 
     try:

@@ -90,6 +90,9 @@ class LocalBench:
         # Per-primary Telemetry.Scrape snapshots from the last run()
         # (gRPC, taken just before teardown; sweep.py embeds them).
         self.telemetry_scrapes: dict[str, dict] = {}
+        # Per-child open-fd counts sampled at steady state just before
+        # teardown (sweep.py records the max as the per-node fd figure).
+        self.child_fd_counts: dict[int, int] = {}
 
     # -- config generation (local.py + config.py of the reference) ---------
 
@@ -192,6 +195,20 @@ class LocalBench:
             raise RuntimeError(
                 f"nodes failed to boot within {timeout}s: {sorted(pending)}"
             )
+
+    def _sample_child_fds(self) -> dict[int, int]:
+        """Open-fd count of each live child (node or client) via procfs —
+        the per-process number RLIMIT_NOFILE actually judges. Sampled at
+        steady state, after every mesh/pool connection is up."""
+        counts: dict[int, int] = {}
+        for p in self.procs:
+            if p.poll() is not None:
+                continue
+            try:
+                counts[p.pid] = len(os.listdir(f"/proc/{p.pid}/fd"))
+            except OSError:
+                pass
+        return counts
 
     def _kill_all(self) -> None:
         for p in self.procs:
@@ -301,6 +318,7 @@ class LocalBench:
             # Scrape-then-kill: the telemetry surface is only reachable
             # while the fleet is alive (sweep.py embeds this in its rows).
             self.telemetry_scrapes = self._scrape_primaries(alive)
+            self.child_fd_counts = self._sample_child_fds()
         finally:
             self._kill_all()
         return LogParser.process(

@@ -58,6 +58,11 @@ def run_once(rate: int, args) -> dict:
     record["faults"] = args.faults
     record["cert_format"] = args.cert_format
     record["verify_rule"] = args.verify_rule
+    # Socket-wall axis: worst per-process open-fd count across the fleet,
+    # sampled at steady state (pooled transport target: O(N) per node).
+    record["peak_fds_per_node"] = max(
+        bench.child_fd_counts.values(), default=None
+    )
     # Node 0's Telemetry.Scrape (gRPC, taken while the fleet was alive):
     # counters/gauges + histogram sums embedded so each sweep row is
     # self-contained for later A/Bs; other nodes' scrapes stay out to keep

@@ -19,6 +19,7 @@ from collections import deque
 from dataclasses import asdict, dataclass, field, replace
 from typing import Mapping
 
+from .bounded_cache import BoundedCache
 from .crypto import digest256
 from .types import Epoch, PublicKey, Round, WorkerId
 
@@ -147,6 +148,27 @@ class Parameters:
     #           (HeaderResyncRequest keyed off their last-seen round).
     # Env override: NARWHAL_HEADER_WIRE.
     header_wire: str = "delta"
+    # -- connection pool (network/pool.py) ---------------------------------
+    # One multiplexed authenticated connection per peer NODE pair: every
+    # lane (primary plane + each worker plane) of the pair shares one
+    # socket with a lane id in the frame header, taking an N-node W-worker
+    # mesh from O(N^2 * (1+W)) sockets to one per unordered pair (the anemo
+    # one-QUIC-connection-per-peer model). False restores per-role-pair
+    # dedicated connections. Env kill-switch: NARWHAL_POOL=0.
+    connection_pool: bool = True
+    # Crossed-dial damping: the pool end whose network key sorts HIGHER
+    # than the peer's waits this long for the peer's inbound connection to
+    # be adopted before dialing itself (the canonical connection is the one
+    # dialed by the lower key; a crossed dial is resolved by closing the
+    # higher side's, so this wait turns a boot-time close/redial churn into
+    # a no-op for all but the slowest pairs).
+    pool_passive_dial_delay: float = 0.2
+    # Grace period before the losing connection of a crossed dial is torn
+    # down, letting responses already in flight on it drain.
+    pool_linger: float = 1.0
+    # Byte budget of the per-server relay dedup cache (digest-keyed decoded
+    # messages; duplicate RelayMsg/Relay2Msg copies skip the codec).
+    relay_dedup_cache_bytes: int = 32 << 20
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)
@@ -165,6 +187,15 @@ class Parameters:
     def import_(path: str) -> "Parameters":
         with open(path) as f:
             return Parameters.from_json(f.read())
+
+
+def connection_pool_effective(parameters: "Parameters") -> bool:
+    """Whether the node runs the per-peer-pair connection pool after the
+    NARWHAL_POOL env kill-switch (0/false/off forces dedicated per-role
+    connections, the pre-pool behavior)."""
+    if os.environ.get("NARWHAL_POOL", "1").lower() in ("0", "false", "off"):
+        return False
+    return bool(parameters.connection_pool)
 
 
 def pacing_enabled() -> bool:
@@ -240,6 +271,9 @@ class Committee:
         self._index: dict[PublicKey, int] = {pk: i for i, pk in enumerate(self._keys)}
         self._total_stake: Stake = sum(a.stake for a in self.authorities.values())
         self._transcript_digest: bytes | None = None
+        # Structural signer-set memo (see signer_group): one computation
+        # per distinct certificate signer tuple under this committee.
+        self._signer_groups = BoundedCache(max_entries=1 << 16)
 
     # -- size / stake -----------------------------------------------------
     def size(self) -> int:
@@ -289,6 +323,39 @@ class Committee:
 
     def stakes_array(self) -> list[Stake]:
         return [self.authorities[pk].stake for pk in self._keys]
+
+    def signer_group(
+        self, signers: tuple[int, ...]
+    ) -> tuple[tuple[PublicKey, ...], Stake]:
+        """Memoized structural resolution of a certificate signer set:
+        `(signer public keys in order, their total stake)`, validated for
+        duplicates and index range — computed ONCE per (committee, signer
+        tuple) instead of per certificate COPY. In the relay fan-out every
+        member re-verifies the same certificate, so at N=200 the per-copy
+        O(N) index/stake walk was a top-3 term of the liveness wall; the
+        same few thousand distinct signer sets recur across copies and
+        sanitize/verify stages. Committees are immutable after construction
+        (reconfigure builds a new one), so memoizing on the instance is
+        safe. Raises ValueError on malformed sets (config cannot import the
+        DAG error types; callers wrap)."""
+        group = self._signer_groups.get(signers)
+        if group is None:
+            if len(set(signers)) != len(signers):
+                raise ValueError("duplicate signers")
+            keys = self._keys
+            pks = []
+            stake = 0
+            for idx in signers:
+                if idx >= len(keys):
+                    raise ValueError(f"signer index {idx} out of range")
+                pk = keys[idx]
+                stake += self.authorities[pk].stake
+                pks.append(pk)
+            group = (tuple(pks), stake)
+            # First write wins (deterministic values), so a concurrent
+            # resolution of the same tuple settles on one canonical group.
+            self._signer_groups.put(signers, group)
+        return group
 
     # -- leader election --------------------------------------------------
     def leader(self, seed: int) -> PublicKey:

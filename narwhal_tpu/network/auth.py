@@ -56,8 +56,10 @@ from ..types import PublicKey
 
 HS_TIMEOUT = 5.0
 MAC_LEN = 16  # AES-GCM authentication tag appended to every sealed body
-_CLIENT_DOMAIN = b"narwhal-hs-client-v4"
-_SERVER_DOMAIN = b"narwhal-hs-server-v4"
+# v5: the frame header grew a lane byte (rpc._FRAME_HDR) which is part of
+# the AEAD associated data — both ends must speak the same header layout.
+_CLIENT_DOMAIN = b"narwhal-hs-client-v5"
+_SERVER_DOMAIN = b"narwhal-hs-server-v5"
 
 # Handshake frame kinds (share the RPC frame header; rid/tag are zero).
 KIND_HELLO = 3  # server -> client: nonce_s(32) | server_pub(32) | server_eph(32)
@@ -253,17 +255,26 @@ class Session:
         self._recv_seq = 0
 
     @staticmethod
-    def _aad(kind: int, rid: int, tag: int) -> bytes:
-        return bytes([kind]) + rid.to_bytes(8, "little") + tag.to_bytes(2, "little")
+    def _aad(kind: int, rid: int, tag: int, lane: int = 0) -> bytes:
+        return (
+            bytes([kind])
+            + rid.to_bytes(8, "little")
+            + tag.to_bytes(2, "little")
+            + bytes([lane])
+        )
 
-    def seal_body(self, kind: int, rid: int, tag: int, body: bytes) -> bytes:
+    def seal_body(
+        self, kind: int, rid: int, tag: int, body: bytes, lane: int = 0
+    ) -> bytes:
         """Encrypt+authenticate a frame body; returns ciphertext||tag(16).
         The counter nonce is unique per (key, direction) by construction."""
         nonce = self._send_seq.to_bytes(12, "little")
         self._send_seq += 1
-        return self._send.encrypt(nonce, body, self._aad(kind, rid, tag))
+        return self._send.encrypt(nonce, body, self._aad(kind, rid, tag, lane))
 
-    def open_body(self, kind: int, rid: int, tag: int, ct: bytes) -> bytes:
+    def open_body(
+        self, kind: int, rid: int, tag: int, ct: bytes, lane: int = 0
+    ) -> bytes:
         """Decrypt+verify; raises AuthError on any tampering, injection,
         replay or reordering (the nonce is the expected sequence number)."""
         if _HAVE_OPENSSL:
@@ -273,7 +284,7 @@ class Session:
 
         nonce = self._recv_seq.to_bytes(12, "little")
         try:
-            body = self._recv.decrypt(nonce, ct, self._aad(kind, rid, tag))
+            body = self._recv.decrypt(nonce, ct, self._aad(kind, rid, tag, lane))
         except InvalidTag:
             raise AuthError("frame AEAD authentication failed") from None
         self._recv_seq += 1
@@ -369,7 +380,7 @@ async def client_handshake(
     """Client half: await HELLO, check the server presents the key the
     committee lists for this address, run the signed X25519 exchange and
     return the frame-MAC session. Raises AuthError on any mismatch."""
-    kind, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
+    kind, _, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
     if kind != KIND_HELLO or len(body) != 96:
         raise AuthError("peer did not open with a handshake HELLO")
     nonce_s, server_pub, server_eph = body[:32], body[32:64], body[64:]
@@ -385,7 +396,7 @@ async def client_handshake(
     sig = credentials.keypair.sign(_CLIENT_DOMAIN + transcript)
     write_frame(writer, KIND_AUTH, 0, 0, client_pub + nonce_c + client_eph + sig)
     await writer.drain()
-    kind, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
+    kind, _, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
     if kind != KIND_AUTH_OK or len(body) != 64:
         raise AuthError("server rejected handshake")
     if not verify(server_pub, _SERVER_DOMAIN + transcript, body):
@@ -411,7 +422,7 @@ async def server_handshake(
     server_eph = _raw_x25519_pub(eph_priv)
     write_frame(writer, KIND_HELLO, 0, 0, nonce_s + server_pub + server_eph)
     await writer.drain()
-    kind, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
+    kind, _, _, _, body = await asyncio.wait_for(read_frame(reader), HS_TIMEOUT)
     if kind != KIND_AUTH or len(body) != 160:
         raise AuthError("client did not authenticate")
     client_pub, nonce_c, client_eph, sig = (

@@ -37,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..bounded_cache import BoundedCache
 from ..clock import now
 from ..channels import CancelOnDrop
 from ..codec import Reader, Writer
@@ -303,6 +304,13 @@ class FanoutBroadcaster:
         self._header_ack_ids: dict[Round, Digest] = {}
         # Short-lived best-effort tasks (ack sends), kept strongly.
         self._tasks: set[asyncio.Task] = set()
+        # ack_ids whose envelope we already forwarded to our children:
+        # duplicate copies of the same broadcast (several relayers share us
+        # as a child) still ACK per copy — the origin's fallback timer needs
+        # every receipt — but re-forwarding each copy would re-amplify the
+        # whole subtree O(copies) times. Bounded FIFO; capacity comfortably
+        # covers the in-flight rounds of the largest committees.
+        self._forwarded = BoundedCache(max_entries=8192)
         self._trees = _TreeCache()
         self.change_epoch(committee)
 
@@ -426,25 +434,28 @@ class FanoutBroadcaster:
             # inner message still buffers/drops through the core's epoch
             # logic, and the origin's fallback covers our would-be subtree.
             return
-        children = self._trees.children(
-            self.committee, msg.epoch, msg.round, msg.origin, self.name,
-            self.fanout,
-        )
-        forwards = [
-            self.network.send(self.committee.primary_address(child), msg)
-            for child in children
-            if child != msg.origin
-        ]
-        self._round_handles.setdefault(msg.round, []).extend(forwards)
-        if self.metrics is not None and forwards:
-            self.metrics.relays_forwarded.inc(len(forwards))
+        ack_id = msg.ack_id
+        if self._forwarded.get(ack_id) is None:
+            self._forwarded.put(ack_id, True)
+            children = self._trees.children(
+                self.committee, msg.epoch, msg.round, msg.origin, self.name,
+                self.fanout,
+            )
+            forwards = [
+                self.network.send(self.committee.primary_address(child), msg)
+                for child in children
+                if child != msg.origin
+            ]
+            self._round_handles.setdefault(msg.round, []).extend(forwards)
+            if self.metrics is not None and forwards:
+                self.metrics.relays_forwarded.inc(len(forwards))
         try:
             origin_address = self.committee.primary_address(msg.origin)
         except KeyError:
             return
         task = asyncio.ensure_future(
             self.network.unreliable_send(
-                origin_address, RelayAckMsg(msg.ack_id, self.name), timeout=5.0
+                origin_address, RelayAckMsg(ack_id, self.name), timeout=5.0
             )
         )
         self._tasks.add(task)
@@ -461,17 +472,21 @@ class FanoutBroadcaster:
         at N=50."""
         if msg.epoch != self.committee.epoch or origin == self.name:
             return
-        children = self._trees.children(
-            self.committee, msg.epoch, msg.round, origin, self.name,
-            self.fanout,
-        )
-        sends = [
-            self.network.oneway_send(self.committee.primary_address(child), msg)
-            for child in children
-            if child != origin
-        ]
-        if self.metrics is not None and sends:
-            self.metrics.relays_forwarded.inc(len(sends))
+        ack_id = msg.ack_id
+        sends = []
+        if self._forwarded.get(ack_id) is None:
+            self._forwarded.put(ack_id, True)
+            children = self._trees.children(
+                self.committee, msg.epoch, msg.round, origin, self.name,
+                self.fanout,
+            )
+            sends = [
+                self.network.oneway_send(self.committee.primary_address(child), msg)
+                for child in children
+                if child != origin
+            ]
+            if self.metrics is not None and sends:
+                self.metrics.relays_forwarded.inc(len(sends))
         try:
             my_index = self.committee.index_of(self.name)
             origin_address = self.committee.primary_address(origin)
@@ -483,7 +498,7 @@ class FanoutBroadcaster:
         if my_index is not None and msg.kind != R2_DELTA_HEADER:
             sends.append(
                 self.network.oneway_send(
-                    origin_address, RelayAck2Msg(msg.ack_id, my_index)
+                    origin_address, RelayAck2Msg(ack_id, my_index)
                 )
             )
         for coro in sends:

@@ -19,6 +19,7 @@ from ..config import (
     Committee,
     Parameters,
     WorkerCache,
+    connection_pool_effective,
     env_float,
     header_wire_effective,
     pacing_enabled,
@@ -103,17 +104,45 @@ class Primary:
                 committee_resolver(lambda: self.committee, lambda: self.worker_cache),
             )
         # Per-link wire accounting: every frame this primary writes/reads,
-        # by message type (wire_bytes_{sent,received}_total{msg_type=}) —
-        # the measurement plane for the fanout/delta wire diet.
+        # by message type and lane (wire_bytes_{sent,received}_total
+        # {msg_type=,lane=}) — the measurement plane for the fanout/delta
+        # wire diet and the pool's lane interleaving.
         self.wire_counters = WireCounters(self.registry)
+        # Connection pool: ONE multiplexed authenticated stream per peer
+        # node pair, shared by every co-hosted lane (network/pool.py). The
+        # primary — holder of the node's network keypair — owns the pool
+        # and registers it for the node's workers to join at spawn.
+        # Pooling needs the authenticated handshake (the link identity IS
+        # the verified network key), so bare unauthenticated assemblies run
+        # legacy dedicated connections.
+        self.pool = None
+        if credentials is not None and connection_pool_effective(parameters):
+            from ..network import LanePool, register_node_pool
+
+            self.pool = LanePool(
+                network_keypair.public,
+                credentials,
+                lambda: self.committee,
+                lambda: self.worker_cache,
+                counters=self.wire_counters,
+                passive_dial_delay=parameters.pool_passive_dial_delay,
+                linger=parameters.pool_linger,
+            )
+            register_node_pool(self.name, self.pool)
         self.network = NetworkClient(
-            credentials=credentials, counters=self.wire_counters
+            credentials=credentials, counters=self.wire_counters, pool=self.pool
         )
         self.server = RpcServer(
             parameters.max_concurrent_requests,
             auth_keypair=network_keypair,
             counters=self.wire_counters,
+            pool=self.pool,
+            dedup_cache_bytes=parameters.relay_dedup_cache_bytes,
         )
+        if self.pool is not None:
+            from ..network import LANE_PRIMARY
+
+            self.pool.register_lane(LANE_PRIMARY, self.server)
         self._tasks: list[asyncio.Task] = []
 
         # Channels (primary.rs:104-151), each with a depth gauge — SURVEY
@@ -305,11 +334,23 @@ class Primary:
             CertificateRefMsg, self._on_certificate_ref, allow=allow_peer_primary
         )
         # Wire-diet plane: relay envelopes + delta announcements + resync.
-        self.server.route(RelayMsg, self._on_relay, allow=allow_peer_primary)
+        # Relay envelopes are forwarded UNCHANGED hop to hop, so duplicate
+        # copies arriving from different relayers are byte-identical: the
+        # dedup= shortcut answers all but the first from the server's
+        # digest cache — ack/forward bookkeeping still runs, but the codec
+        # decode and the core's sanitize path are paid once per payload,
+        # not once per copy (the N=200 per-copy decode tax).
+        self.server.route(
+            RelayMsg, self._on_relay, allow=allow_peer_primary,
+            dedup=self._on_relay_dup,
+        )
         self.server.route(RelayAckMsg, self._on_relay_ack, allow=allow_peer_primary)
         from ..messages import Relay2Msg, RelayAck2Msg, Vote2Msg
 
-        self.server.route(Relay2Msg, self._on_relay2, allow=allow_peer_primary)
+        self.server.route(
+            Relay2Msg, self._on_relay2, allow=allow_peer_primary,
+            dedup=self._on_relay2_dup,
+        )
         self.server.route(
             RelayAck2Msg, self._on_relay_ack2, allow=allow_peer_primary
         )
@@ -367,6 +408,13 @@ class Primary:
                 info.name
                 for info in self.worker_cache.our_workers(self.name).values()
             )
+            # Pooled links authenticate with the NODE identity (the
+            # authority network key) rather than per-worker keys, so our
+            # own workers' traffic over the self-link presents our own
+            # network key — the anemo node-granularity trust model.
+            own = self.committee.authorities.get(self.name)
+            if own is not None:
+                workers = workers | {own.network_key}
             return primaries, workers
 
         return cached_allow_sets(self, self.committee, self.worker_cache, build)
@@ -454,6 +502,25 @@ class Primary:
             await self._on_certificate_ref(inner, peer)
         else:
             logger.warning("relay carried unexpected %r", type(inner))
+
+    async def _on_relay_dup(self, msg: RelayMsg, peer: str):
+        """Duplicate copy of a relay envelope already decoded (the server's
+        digest cache hit before the codec ran): only the bookkeeping —
+        forward to our tree children if we have not yet, ack the origin so
+        its fallback timer stands down. The inner announcement was already
+        delivered by the first copy; re-ingesting it would just re-pay
+        sanitize/verify for a no-op."""
+        self.fanout.on_relay(msg)
+        return None
+
+    async def _on_relay2_dup(self, msg, peer: str):
+        """Slim-envelope duplicate: ack/forward bookkeeping without the
+        decode_relay2 reconstruction or re-delivery (see _on_relay_dup)."""
+        if msg.epoch != self.committee.epoch:
+            return None
+        origin = self.committee.key_of(msg.origin_index)
+        self.fanout.on_relay2(msg, origin)
+        return None
 
     async def _on_relay_ack(self, msg: RelayAckMsg, peer):
         self.fanout.on_ack(msg, getattr(peer, "key", None))
@@ -637,4 +704,9 @@ class Primary:
             t.cancel()
         await drain_cancelled(self._tasks, who="primary")
         await self.server.stop()
+        if self.pool is not None:
+            from ..network import unregister_node_pool
+
+            unregister_node_pool(self.name, self.pool)
+            self.pool.close()
         self.network.close()

@@ -256,6 +256,7 @@ class SimFabric:
             "delivers": 0,
             "bytes_delivered": 0,
             "resets": 0,
+            "peak_conns": 0,
         }
         SimFabric.last_counters = self.counters
         # Batched delivery: one min-heap of (deliver_t, seq, kind, conn,
@@ -376,6 +377,8 @@ class SimFabric:
             await asyncio.sleep(delay)
         conn = _SimConnection(self, src, dst or key, key, limit)
         self._conns.add(conn)
+        if len(self._conns) > self.counters["peak_conns"]:
+            self.counters["peak_conns"] = len(self._conns)
         self.log.append("connect", conn.id, src or "client", key)
         self.counters["connects"] += 1
         server_writer = _SimWriter(conn, 1)

@@ -788,7 +788,7 @@ class Certificate:
     def is_genesis(self) -> bool:
         return self.round == 0
 
-    def _signer_checks(self, committee) -> list[bytes] | None:
+    def _signer_checks(self, committee) -> tuple[bytes, ...] | None:
         """Shared structural checks: epoch, genesis well-formedness, arity,
         duplicate signers, index range, quorum stake. Returns the signer
         public keys in order (None for genesis)."""
@@ -800,17 +800,14 @@ class Certificate:
             return None
         if len(self.signers) != len(self.signatures):
             raise DagError("signer/signature arity mismatch")
-        if len(set(self.signers)) != len(self.signers):
-            raise DagError("duplicate signers")
-        keys = committee.authority_keys()
-        pks = []
-        stake = 0
-        for idx in self.signers:
-            if idx >= len(keys):
-                raise DagError(f"signer index {idx} out of range")
-            pk = keys[idx]
-            stake += committee.stake(pk)
-            pks.append(pk)
+        # Duplicate/range validation and the O(N) key+stake walk are
+        # memoized per (committee, signer tuple): in the relay fan-out the
+        # same certificate reaches every member N-1 times and each copy
+        # used to re-pay the walk (a top-3 term of the N=200 wall).
+        try:
+            pks, stake = committee.signer_group(self.signers)
+        except ValueError as e:
+            raise DagError(str(e)) from e
         if stake < committee.quorum_threshold():
             raise QuorumNotReached(
                 f"certificate carries {stake} stake < quorum {committee.quorum_threshold()}"

@@ -105,8 +105,17 @@ class Worker:
         # Per-link wire accounting for the payload plane (batch
         # dissemination is the data-plane bulk of MB/round).
         self.wire_counters = WireCounters(self.registry)
+        # Join the co-hosted node's connection pool (network/pool.py): the
+        # Primary — holder of the node's network keypair — creates and
+        # registers it under the authority name; this worker's mesh lane
+        # then rides the node pair's ONE multiplexed connection. Absent
+        # pool (standalone worker, split deployment, NARWHAL_POOL=0) the
+        # worker keeps legacy dedicated connections.
+        from ..network import node_pool
+
+        self.pool = node_pool(self.name) if network_keypair is not None else None
         self.network = NetworkClient(
-            credentials=credentials, counters=self.wire_counters
+            credentials=credentials, counters=self.wire_counters, pool=self.pool
         )
         self.server = RpcServer(
             parameters.max_concurrent_requests,
@@ -178,6 +187,19 @@ class Worker:
             )
 
     async def spawn(self) -> None:
+        # The node pool may have been registered after our construction
+        # (assembly order is harness-specific); re-check before binding so
+        # this worker's lane joins it either way.
+        if self.pool is None and self.network_keypair is not None:
+            from ..network import node_pool
+
+            self.pool = node_pool(self.name)
+            if self.pool is not None:
+                self.network.attach_pool(self.pool)
+        if self.pool is not None:
+            from ..network import worker_lane
+
+            self.pool.register_lane(worker_lane(self.worker_id), self.server)
         me = self.worker_cache.worker(self.name, self.worker_id)
         host, port = me.worker_address.rsplit(":", 1)
         bound = await self.server.start(host, int(port))
@@ -308,6 +330,12 @@ class Worker:
                         self.name, self.worker_id
                     )
                 }
+                # Pooled links authenticate with the peer NODE's identity
+                # (its authority network key), not the per-worker key —
+                # the anemo node-granularity trust model: any committee
+                # node may reach the batch plane, exactly the set whose
+                # same-lane workers could anyway.
+                | {a.network_key for a in self.committee.authorities.values()}
             )
             own_primary = frozenset({self.committee.network_key(self.name)})
             return lane, own_primary
@@ -407,6 +435,10 @@ class Worker:
         for t in self._tasks:
             t.cancel()
         await drain_cancelled(self._tasks, who="worker")
+        if self.pool is not None:
+            from ..network import worker_lane
+
+            self.pool.unregister_lane(worker_lane(self.worker_id))
         await self.server.stop()
         await self.tx_server.stop()
         if hasattr(self, "grpc_transactions"):

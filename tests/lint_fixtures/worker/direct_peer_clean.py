@@ -1,0 +1,19 @@
+"""Clean fixture for no-direct-peer-connection: peers reached through the
+pooled client surface; unrelated open()/connection-flavored calls don't
+match."""
+
+
+async def send_all(network, pool, peer_key, address, msg):
+    # The sanctioned surfaces: the facade and the pool itself.
+    peer = network.peer(address)
+    await peer.request(msg)
+    link = await pool.link_for(peer_key)
+    await link.oneway(msg, 0)
+    # Connection-flavored but unrelated: never matches.
+    store = open_store(address)
+    conn = store.connection()
+    return conn
+
+
+def open_store(path):
+    return path

@@ -1,0 +1,21 @@
+"""Tripping fixture for no-direct-peer-connection: dedicated sockets opened
+outside the LanePool in a scoped dir (4 findings pinned)."""
+
+import asyncio
+
+from narwhal_tpu.network import PeerClient, transport
+from narwhal_tpu.network import rpc
+
+
+async def dial_everything(address, credentials):
+    host, port = address.rsplit(":", 1)
+    # Direct transport dial (the pool's own privilege, not ours).
+    reader, writer = await transport.open_connection(
+        host, int(port), limit=1024
+    )
+    # Raw asyncio dial sidesteps even the transport seam.
+    r2, w2 = await asyncio.open_connection(host, int(port))
+    # Hand-built legacy clients: direct import and attribute form.
+    a = PeerClient(address, credentials)
+    b = rpc.PeerClient(address, credentials)
+    return reader, writer, r2, w2, a, b

@@ -60,28 +60,50 @@ def test_sweep_table_finds_knee():
 
 
 def test_fd_preflight_estimates_and_fails_fast(monkeypatch):
-    """The liveness preflight: N=100 W=1 demands ~2·N·(N-1)·2 fds (the
-    n100_liveness.json EMFILE at ~19.8k mesh sockets under a 20k limit),
-    and the check fails BEFORE boot with a message pointing at --simnet."""
+    """The liveness preflight, honest for BOTH transport models: legacy
+    N=100 W=1 demands ~2·N·(N-1)·2 fds (the r9 n100_liveness.json EMFILE
+    at ~19.8k mesh sockets under a 20k limit) and fails BEFORE boot with a
+    message pointing at --simnet; pooled collapses that to one link per
+    node pair and fits the same rlimit."""
     import resource
 
     import pytest
 
     from benchmark.liveness import estimate_required_fds, preflight_fd_check
 
-    # The estimate must at least cover the measured N=100 failure (~19.8k
-    # mesh sockets => ~40k fds both-endpoints-in-process).
-    assert estimate_required_fds(100, 1) > 19_800
-    # Monotone in both axes.
-    assert estimate_required_fds(100, 2) > estimate_required_fds(100, 1)
-    assert estimate_required_fds(200, 1) > estimate_required_fds(100, 1)
+    # Legacy estimate must at least cover the measured N=100 failure
+    # (~19.8k mesh sockets => ~40k fds both-endpoints-in-process).
+    assert estimate_required_fds(100, 1, pooled=False) > 19_800
+    # Pooled: N(N-1)/2 pair links + N self links, worker lanes ride them —
+    # ~13.5k fds, comfortably under the 20k rlimit that EMFILEd r9.
+    assert estimate_required_fds(100, 1, pooled=True) < 20_000
+    assert (
+        estimate_required_fds(100, 1, pooled=True)
+        < estimate_required_fds(100, 1, pooled=False)
+    )
+    # Monotone in both axes, in both models.
+    for pooled in (True, False):
+        assert estimate_required_fds(100, 2, pooled) > estimate_required_fds(
+            100, 1, pooled
+        )
+        assert estimate_required_fds(200, 1, pooled) > estimate_required_fds(
+            100, 1, pooled
+        )
 
     monkeypatch.setattr(
         resource, "getrlimit", lambda which: (20_000, 20_000)
     )
     with pytest.raises(SystemExit) as err:
-        preflight_fd_check(100, 1)
+        preflight_fd_check(100, 1, pooled=False)
     msg = str(err.value)
     assert "--simnet" in msg and "RLIMIT_NOFILE" in msg
-    # A committee that fits passes silently.
-    preflight_fd_check(10, 1)
+    # The pooled model fits the very rlimit that EMFILEd the legacy mesh.
+    preflight_fd_check(100, 1, pooled=True)
+    # The default resolves pooling from NARWHAL_POOL (on unless disabled).
+    monkeypatch.setenv("NARWHAL_POOL", "0")
+    with pytest.raises(SystemExit):
+        preflight_fd_check(100, 1)
+    monkeypatch.delenv("NARWHAL_POOL")
+    preflight_fd_check(100, 1)
+    # A committee that fits passes silently in either model.
+    preflight_fd_check(10, 1, pooled=False)

@@ -162,3 +162,25 @@ def test_full_registry_format_snapshot():
         "wire format drift; regenerate with REGEN_SNAPSHOTS=1 only if the "
         "change is intentional"
     )
+
+
+def test_frame_header_format_snapshot():
+    """Golden bytes for the transport frame header (network/rpc.py `_pack`):
+    `<len u32><kind u8><rid u64><tag u16><lane u8>` little-endian. The lane
+    byte (pool lane multiplexing) was an ADD-ONLY change — everything
+    before it is byte-identical to the pre-pool header, and plaintext
+    legacy frames carry lane 0."""
+    from narwhal_tpu.network.rpc import KIND_ONEWAY, KIND_REQ, _pack
+
+    frame = _pack(KIND_REQ, 0x0102030405060708, 73, b"body", lane=3)
+    assert frame == (
+        b"\x04\x00\x00\x00"  # len u32 = 4
+        b"\x00"  # kind u8 = KIND_REQ
+        b"\x08\x07\x06\x05\x04\x03\x02\x01"  # rid u64
+        b"\x49\x00"  # tag u16 = 73
+        b"\x03"  # lane u8
+        b"body"
+    )
+    # Default lane is 0 — the legacy single-role wire form.
+    assert _pack(KIND_ONEWAY, 0, 9, b"")[-1:] == b"\x00"
+    assert len(_pack(KIND_REQ, 0, 0, b"")) == 16  # header is 16 bytes

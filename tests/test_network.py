@@ -340,9 +340,9 @@ def test_rpc_coalescing_equivalence_concurrent_vs_sequential(run):
         orig_read = rpc_mod._read_frame
 
         async def spy_read(reader, session=None, counters=None):
-            kind, rid, tag, body = await orig_read(reader, session, counters)
+            kind, rid, tag, lane, body = await orig_read(reader, session, counters)
             received.append((kind, tag, bytes(body)))
-            return kind, rid, tag, body
+            return kind, rid, tag, lane, body
 
         rpc_mod._read_frame = spy_read
         try:

@@ -112,6 +112,7 @@ EXPECTED_RULES = {
     "no-untracked-jit",
     "no-per-item-cert-verify",
     "metric-naming",
+    "no-direct-peer-connection",
 }
 
 FIXTURE_FOR = {
@@ -154,6 +155,10 @@ FIXTURE_FOR = {
     "metric-naming": (
         "metric_naming_trip.py",
         "metric_naming_clean.py",
+    ),
+    "no-direct-peer-connection": (
+        "worker/direct_peer_trip.py",
+        "worker/direct_peer_clean.py",
     ),
 }
 
@@ -204,6 +209,8 @@ def test_fixture_finding_counts():
         # bad snake_case, unknown subsystem, unitless histogram, unitless
         # perf histogram (perf is a registered subsystem; grammar holds)
         "metric-naming": 4,
+        # transport dial, raw asyncio dial, PeerClient direct + attr form
+        "no-direct-peer-connection": 4,
     }
     for rule_name, expected in counts.items():
         trip, _ = FIXTURE_FOR[rule_name]

@@ -1204,3 +1204,54 @@ class MetricNaming(Rule):
                     f"({'/'.join(sorted(self._UNITS))}) so readers know "
                     "what the buckets measure",
                 )
+
+
+# ---------------------------------------------------------------------------
+# no-direct-peer-connection
+# ---------------------------------------------------------------------------
+
+
+@register
+class NoDirectPeerConnection(Rule):
+    name = "no-direct-peer-connection"
+    summary = (
+        "in primary/, worker/ and executor/, peer connections must go "
+        "through the node's LanePool (NetworkClient.peer routes committee "
+        "addresses onto the one pooled link per peer pair): a direct "
+        "transport.open_connection / asyncio.open_connection or a "
+        "hand-built PeerClient(...) opens a dedicated socket per call "
+        "site, quietly re-growing the O(N^2*(1+W)) mesh the pool "
+        "collapsed — the socket wall n100_liveness.json died on"
+    )
+
+    _SCOPED_DIRS = frozenset({"primary", "worker", "executor"})
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if not in_dirs(mod, self._SCOPED_DIRS):
+            return
+        aliases = import_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolve(node.func, aliases)
+            if resolved is None:
+                continue
+            leaf = resolved.rsplit(".", 1)[-1]
+            if leaf == "open_connection":
+                yield self.finding(
+                    mod,
+                    node,
+                    f"direct socket dial `{dotted(node.func)}(...)`: peer "
+                    "connections belong to the LanePool (one multiplexed "
+                    "link per peer pair) — use NetworkClient.peer / "
+                    "pool.link_for instead of opening a dedicated stream",
+                )
+            elif leaf == "PeerClient":
+                yield self.finding(
+                    mod,
+                    node,
+                    f"hand-built `{dotted(node.func)}(...)`: construct "
+                    "peers via NetworkClient.peer so committee addresses "
+                    "ride the pooled lane (PeerClient is the pool's "
+                    "internal legacy fallback, not an application API)",
+                )
